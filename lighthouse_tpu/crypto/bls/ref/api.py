@@ -1,0 +1,316 @@
+"""Pure-Python BLS signature API (the `python_ref` backend).
+
+Mirrors the reference's backend trait surface — `TSecretKey`, `TPublicKey`,
+`TSignature`, `TAggregateSignature` and the module-level batch verifier
+(/root/reference/crypto/bls/src/lib.rs:95-151,
+/root/reference/crypto/bls/src/impls/blst.rs:36-119,233-257) — including:
+
+  - ZCash compressed serialization (48-byte G1 pubkeys, 96-byte G2 sigs)
+  - infinity-pubkey rejection on deserialize+use (lib.rs:61-64)
+  - subgroup checks on deserialization of untrusted points
+  - batch verification by random linear combination ("Vitalik's method",
+    impls/blst.rs:36-119): n+1 Miller loops, one final exponentiation,
+    nonzero 64-bit scalars (impls/blst.rs:15 RAND_BITS = 64)
+  - interop deterministic keypairs
+    (/root/reference/common/eth2_interop_keypairs/src/lib.rs:44-58)
+"""
+
+from __future__ import annotations
+
+import hashlib
+import secrets
+from dataclasses import dataclass
+
+from ..constants import DST, G1_GENERATOR_X, G1_GENERATOR_Y, P, R
+from .curves import Point, _B1, _B2, g1_generator, g1_infinity, g2_generator, g2_infinity
+from .fields import Fp, Fp2
+from .hash_to_curve import hash_to_g2
+from .pairing import miller_loop, final_exponentiation, multi_pairing
+
+RAND_BITS = 64  # impls/blst.rs:15
+
+# -- point (de)serialization, ZCash format ------------------------------------
+
+_COMP_FLAG = 0x80
+_INF_FLAG = 0x40
+_SIGN_FLAG = 0x20
+_HALF_P = (P - 1) // 2
+
+
+def _fp_sign(y: Fp) -> int:
+    return 1 if y.n > _HALF_P else 0
+
+
+def _fp2_sign(y: Fp2) -> int:
+    """Lexicographic 'is largest' with c1 most significant."""
+    if y.c1.n != 0:
+        return 1 if y.c1.n > _HALF_P else 0
+    return 1 if y.c0.n > _HALF_P else 0
+
+
+def g1_to_compressed(pt: Point) -> bytes:
+    if pt.inf:
+        return bytes([_COMP_FLAG | _INF_FLAG]) + bytes(47)
+    out = bytearray(pt.x.n.to_bytes(48, "big"))
+    out[0] |= _COMP_FLAG | (_SIGN_FLAG if _fp_sign(pt.y) else 0)
+    return bytes(out)
+
+
+def g2_to_compressed(pt: Point) -> bytes:
+    if pt.inf:
+        return bytes([_COMP_FLAG | _INF_FLAG]) + bytes(95)
+    out = bytearray(pt.x.c1.n.to_bytes(48, "big") + pt.x.c0.n.to_bytes(48, "big"))
+    out[0] |= _COMP_FLAG | (_SIGN_FLAG if _fp2_sign(pt.y) else 0)
+    return bytes(out)
+
+
+class DecodeError(ValueError):
+    pass
+
+
+def _parse_flags(data: bytes, n: int) -> tuple[bool, bool]:
+    if len(data) != n:
+        raise DecodeError(f"expected {n} bytes, got {len(data)}")
+    flags = data[0]
+    if not flags & _COMP_FLAG:
+        raise DecodeError("uncompressed points not accepted")
+    infinity = bool(flags & _INF_FLAG)
+    sign = bool(flags & _SIGN_FLAG)
+    if infinity:
+        if sign or any(data[1:]) or (data[0] & 0x1F):
+            raise DecodeError("non-canonical infinity encoding")
+    return infinity, sign
+
+
+def g1_from_compressed(data: bytes, subgroup_check: bool = True) -> Point:
+    infinity, sign = _parse_flags(data, 48)
+    if infinity:
+        return g1_infinity()
+    x_int = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:], "big")
+    if x_int >= P:
+        raise DecodeError("x >= p")
+    x = Fp(x_int)
+    y = (x * x * x + _B1).sqrt()
+    if y is None:
+        raise DecodeError("x not on curve")
+    if _fp_sign(y) != sign:
+        y = -y
+    pt = Point(x, y, False, _B1)
+    if subgroup_check and not pt.mul(R).inf:
+        raise DecodeError("point not in G1 subgroup")
+    return pt
+
+
+def g2_from_compressed(data: bytes, subgroup_check: bool = True) -> Point:
+    infinity, sign = _parse_flags(data, 96)
+    if infinity:
+        return g2_infinity()
+    c1 = int.from_bytes(bytes([data[0] & 0x1F]) + data[1:48], "big")
+    c0 = int.from_bytes(data[48:96], "big")
+    if c0 >= P or c1 >= P:
+        raise DecodeError("x coordinate >= p")
+    x = Fp2.from_ints(c0, c1)
+    y = (x * x * x + _B2).sqrt()
+    if y is None:
+        raise DecodeError("x not on curve")
+    if _fp2_sign(y) != sign:
+        y = -y
+    pt = Point(x, y, False, _B2)
+    if subgroup_check and not pt.mul(R).inf:
+        raise DecodeError("point not in G2 subgroup")
+    return pt
+
+
+# -- key and signature types ---------------------------------------------------
+
+
+class SecretKey:
+    __slots__ = ("k",)
+
+    def __init__(self, k: int):
+        if not 0 < k < R:
+            raise ValueError("secret key out of range")
+        self.k = k
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "SecretKey":
+        if len(data) != 32:
+            raise DecodeError("secret key must be 32 bytes")
+        return SecretKey(int.from_bytes(data, "big"))
+
+    @staticmethod
+    def random() -> "SecretKey":
+        return SecretKey(secrets.randbelow(R - 1) + 1)
+
+    def to_bytes(self) -> bytes:
+        return self.k.to_bytes(32, "big")
+
+    def public_key(self) -> "PublicKey":
+        return PublicKey(g1_generator().mul(self.k))
+
+    def sign(self, message: bytes) -> "Signature":
+        return Signature(hash_to_g2(message, DST).mul(self.k))
+
+
+class PublicKey:
+    __slots__ = ("point",)
+
+    def __init__(self, point: Point):
+        self.point = point
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "PublicKey":
+        """Deserialize + validate: rejects infinity (reference rejects
+        infinity pubkeys outright, lib.rs:61-64) and non-subgroup points."""
+        pt = g1_from_compressed(data)
+        if pt.inf:
+            raise DecodeError("infinity public key rejected")
+        return PublicKey(pt)
+
+    def to_bytes(self) -> bytes:
+        return g1_to_compressed(self.point)
+
+    def __eq__(self, o):
+        return isinstance(o, PublicKey) and self.point == o.point
+
+    def __hash__(self):
+        return hash(self.to_bytes())
+
+
+def aggregate_public_keys(pks: list[PublicKey]) -> PublicKey:
+    """eth_aggregate_pubkeys semantics: empty list is an error."""
+    if not pks:
+        raise ValueError("cannot aggregate empty pubkey list")
+    acc = g1_infinity()
+    for pk in pks:
+        acc = acc + pk.point
+    return PublicKey(acc)
+
+
+class Signature:
+    __slots__ = ("point",)
+
+    def __init__(self, point: Point):
+        self.point = point
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Signature":
+        return Signature(g2_from_compressed(data))
+
+    def to_bytes(self) -> bytes:
+        return g2_to_compressed(self.point)
+
+    @staticmethod
+    def infinity() -> "Signature":
+        return Signature(g2_infinity())
+
+    def is_infinity(self) -> bool:
+        return self.point.inf
+
+    def verify(self, pk: PublicKey, message: bytes) -> bool:
+        """e(g1, sig) == e(pk, H(m)), evaluated as a product-is-one check."""
+        if pk.point.inf:
+            return False
+        h = hash_to_g2(message, DST)
+        return multi_pairing([(-g1_generator(), self.point), (pk.point, h)]).is_one()
+
+    def aggregate_verify(self, pks: list[PublicKey], messages: list[bytes]) -> bool:
+        """Distinct-message aggregate verify (impls/blst.rs:246-257)."""
+        if not pks or len(pks) != len(messages):
+            return False
+        if any(pk.point.inf for pk in pks):
+            return False
+        pairs = [(-g1_generator(), self.point)]
+        for pk, msg in zip(pks, messages):
+            pairs.append((pk.point, hash_to_g2(msg, DST)))
+        return multi_pairing(pairs).is_one()
+
+    def fast_aggregate_verify(self, pks: list[PublicKey], message: bytes) -> bool:
+        """Same-message aggregate verify (impls/blst.rs:233-244)."""
+        if not pks:
+            return False
+        agg = aggregate_public_keys(pks)
+        if agg.point.inf:
+            return False
+        return self.verify(agg, message)
+
+    def eth_fast_aggregate_verify(self, pks: list[PublicKey], message: bytes) -> bool:
+        """Altair G2_POINT_AT_INFINITY special case: an infinity signature
+        with zero participants is valid (sync aggregates)."""
+        if not pks and self.is_infinity():
+            return True
+        return self.fast_aggregate_verify(pks, message)
+
+    def __eq__(self, o):
+        return isinstance(o, Signature) and self.point == o.point
+
+
+def aggregate_signatures(sigs: list[Signature]) -> Signature:
+    if not sigs:
+        raise ValueError("cannot aggregate empty signature list")
+    acc = g2_infinity()
+    for s in sigs:
+        acc = acc + s.point
+    return Signature(acc)
+
+
+# -- signature sets & batch verification --------------------------------------
+
+
+@dataclass
+class SignatureSet:
+    """One aggregate-verification unit: {signature, signing_keys, message}
+    (/root/reference/crypto/bls/src/generic_signature_set.rs:61-72)."""
+
+    signature: Signature
+    signing_keys: list[PublicKey]
+    message: bytes  # 32-byte signing root
+
+
+def verify_signature_set(s: SignatureSet) -> bool:
+    return s.signature.fast_aggregate_verify(s.signing_keys, s.message)
+
+
+def verify_signature_sets(sets: list[SignatureSet], rng=None) -> bool:
+    """Batch verification by random linear combination
+    (impls/blst.rs:36-119).
+
+    Checks prod_i [ e(sum(pks_i), H(m_i)) / e(g1, sig_i) ]^{r_i} == 1 with
+    independent nonzero 64-bit scalars r_i, computed as n+1 Miller loops and
+    a single final exponentiation:
+        prod_i ML(r_i * PK_i, H(m_i)) * ML(-g1, sum_i r_i * sig_i)
+    """
+    if not sets:
+        return False
+    rand = rng if rng is not None else secrets.randbits
+    pairs = []
+    sig_acc = g2_infinity()
+    for s in sets:
+        if not s.signing_keys:
+            return False
+        if any(pk.point.inf for pk in s.signing_keys):
+            return False
+        r = 0
+        while r == 0:
+            r = rand(RAND_BITS)
+        pk = aggregate_public_keys(s.signing_keys).point.mul(r)
+        sig_acc = sig_acc + s.signature.point.mul(r)
+        pairs.append((pk, hash_to_g2(s.message, DST)))
+    pairs.append((-g1_generator(), sig_acc))
+    return multi_pairing(pairs).is_one()
+
+
+# -- interop keypairs ----------------------------------------------------------
+
+
+def interop_secret_key(validator_index: int) -> SecretKey:
+    """sha256(LE-padded index) interpreted little-endian, mod r
+    (/root/reference/common/eth2_interop_keypairs/src/lib.rs:44-58)."""
+    preimage = validator_index.to_bytes(8, "little") + bytes(24)
+    k = int.from_bytes(hashlib.sha256(preimage).digest(), "little") % R
+    return SecretKey(k)
+
+
+def interop_keypair(validator_index: int) -> tuple[SecretKey, PublicKey]:
+    sk = interop_secret_key(validator_index)
+    return sk, sk.public_key()
