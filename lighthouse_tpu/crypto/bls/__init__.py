@@ -1,0 +1,103 @@
+"""BLS12-381 with runtime-pluggable backends — the TPU framework's crypto seam.
+
+The reference selects among three BLS implementations at *compile time* via
+cargo features and re-exports one type family
+(/root/reference/crypto/bls/src/lib.rs:8-20,95-151, the `define_mod!` macro).
+This package is the TPU-native equivalent of that seam, with *runtime*
+selection (idiomatic for Python, and necessary because the JAX backend's
+device availability is a runtime property):
+
+    from lighthouse_tpu.crypto.bls import backend
+    bls = backend("jax")      # TPU/JAX batched verifier (the product)
+    bls = backend("ref")      # pure-Python correctness oracle (milagro role)
+    bls = backend("fake")     # always-valid stub        (fake_crypto role)
+
+Each backend module exposes the same surface (the Python rendering of the
+reference's `TPublicKey`/`TSignature`/... trait family):
+
+    SecretKey, PublicKey, Signature, SignatureSet, DecodeError,
+    aggregate_public_keys, aggregate_signatures,
+    verify_signature_set, verify_signature_sets,
+    interop_secret_key, interop_keypair
+
+The module-level names below re-export the *default* backend (like the
+reference's `pub use blst_implementations::*`), resolved from
+`$LIGHTHOUSE_TPU_BLS_BACKEND` (default: "ref" — explicit opt-in to the
+accelerator keeps import of this package free of a JAX dependency).
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import types
+
+from .constants import (  # noqa: F401  (public parameter surface)
+    DST,
+    P,
+    PUBLIC_KEY_BYTES_LEN,
+    R,
+    SECRET_KEY_BYTES_LEN,
+    SIGNATURE_BYTES_LEN,
+)
+
+_BACKEND_MODULES = {
+    "ref": "lighthouse_tpu.crypto.bls.ref.api",
+    "fake": "lighthouse_tpu.crypto.bls.fake",
+    "jax": "lighthouse_tpu.crypto.bls.jax_backend.api",
+}
+
+BACKEND_NAMES = tuple(_BACKEND_MODULES)
+
+# The per-backend API surface every backend module must provide.
+_API = (
+    "SecretKey",
+    "PublicKey",
+    "Signature",
+    "SignatureSet",
+    "DecodeError",
+    "aggregate_public_keys",
+    "aggregate_signatures",
+    "verify_signature_set",
+    "verify_signature_sets",
+    "interop_secret_key",
+    "interop_keypair",
+)
+
+_cache: dict[str, types.ModuleType] = {}
+
+
+def backend(name: str | None = None) -> types.ModuleType:
+    """Return the backend module for `name` (or the default backend).
+
+    Raises ValueError for unknown names; import errors (e.g. jax missing)
+    propagate so callers see the real cause.
+    """
+    if name is None:
+        name = default_backend_name()
+    if name not in _BACKEND_MODULES:
+        raise ValueError(f"unknown BLS backend {name!r}; expected one of {BACKEND_NAMES}")
+    mod = _cache.get(name)
+    if mod is None:
+        mod = importlib.import_module(_BACKEND_MODULES[name])
+        missing = [a for a in _API if not hasattr(mod, a)]
+        if missing:
+            raise ImportError(f"backend {name!r} is missing API members: {missing}")
+        _cache[name] = mod
+    return mod
+
+
+def default_backend_name() -> str:
+    return os.environ.get("LIGHTHOUSE_TPU_BLS_BACKEND", "ref")
+
+
+def __getattr__(attr: str):
+    """PEP 562 lazy re-export of the default backend's types.
+
+    Lazy so that a bad `$LIGHTHOUSE_TPU_BLS_BACKEND` (or a backend whose heavy
+    deps are unavailable) only fails at the point of use — `backend("ref")`
+    stays reachable regardless of the default selection.
+    """
+    if attr in _API:
+        return getattr(backend(), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {attr!r}")

@@ -201,12 +201,19 @@ def _derive_isogeny():
 
 _ISO_X0, _ISO_T, _ISO_U = _derive_isogeny()
 _INV9 = Fp(9).inv()
-_INV27 = Fp(27).inv()
+# Sign pin: the Vélu codomain maps onto E2 by (x, y) -> (u^2 x, u^3 y) for
+# u = ±1/3 — both are isomorphisms, and they differ by point negation, which
+# the x_num coefficient pin above cannot distinguish. RFC 9380's published
+# iso_map uses the u = -1/3 branch (y scaled by -1/27); picking +1/27 negates
+# every hash_to_curve output and breaks signing interop. Pinned externally by
+# the Appendix J.10.1 full-point vectors in tests/test_bls_kat.py.
+_INV27 = -(Fp(27).inv())
 
 
 def iso3_map(x: Fp2, y: Fp2) -> Point:
-    """The derived 3-isogeny E' -> E2 (Vélu composed with (x/9, y/27)) —
-    verified at import to match the RFC 9380 §8.8.2 rational map exactly."""
+    """The derived 3-isogeny E' -> E2 (Vélu composed with (x/9, -y/27)) —
+    verified at import to match the RFC 9380 §8.8.2 rational map exactly
+    (x_num pin at import; y sign pinned by external vectors in tests)."""
     d = x - _ISO_X0
     if d.is_zero():
         # kernel point maps to infinity

@@ -1,0 +1,81 @@
+"""Backend-seam tests: the same drive runs against every backend, the way the
+reference runs its ef_tests matrix once per BLS backend
+(/root/reference/Makefile:98-103)."""
+
+import pytest
+
+from lighthouse_tpu.crypto import bls
+
+# "jax" joins this list via test_bls_jax.py once its differential suite runs;
+# here we exercise the pure-host backends plus seam plumbing.
+HOST_BACKENDS = ["ref", "fake"]
+
+
+def test_unknown_backend_rejected():
+    with pytest.raises(ValueError):
+        bls.backend("blst")
+
+
+def test_default_backend_is_ref():
+    assert bls.backend() is bls.backend("ref")
+    # package-level re-exports point at the default backend
+    assert bls.SecretKey is bls.backend("ref").SecretKey
+
+
+@pytest.mark.parametrize("name", HOST_BACKENDS)
+def test_api_surface_complete(name):
+    mod = bls.backend(name)
+    for attr in bls._API:
+        assert hasattr(mod, attr), f"{name} missing {attr}"
+
+
+@pytest.mark.parametrize("name", HOST_BACKENDS)
+def test_sign_verify_roundtrip(name):
+    b = bls.backend(name)
+    sk, pk = b.interop_keypair(7)
+    msg = bytes(range(32))
+    sig = b.Signature.from_bytes(sk.sign(msg).to_bytes())
+    pk2 = b.PublicKey.from_bytes(pk.to_bytes())
+    assert sig.verify(pk2, msg)
+
+
+@pytest.mark.parametrize("name", HOST_BACKENDS)
+def test_signature_set_batch_rules(name):
+    b = bls.backend(name)
+    sk, pk = b.interop_keypair(0)
+    msg = b"\x11" * 32
+    s = b.SignatureSet(signature=sk.sign(msg), signing_keys=[pk], message=msg)
+    assert b.verify_signature_sets([s])
+    # Structural rules shared by all backends, including fake:
+    assert not b.verify_signature_sets([])  # empty batch
+    empty_keys = b.SignatureSet(signature=sk.sign(msg), signing_keys=[], message=msg)
+    assert not b.verify_signature_sets([empty_keys])
+
+
+@pytest.mark.parametrize("name", HOST_BACKENDS)
+def test_interop_keys_byte_identical_across_backends(name):
+    """interop secret keys are a shared fixture: byte-identical everywhere."""
+    b = bls.backend(name)
+    r = bls.backend("ref")
+    for idx in (0, 1, 92):
+        assert b.interop_secret_key(idx).to_bytes() == r.interop_secret_key(idx).to_bytes()
+
+
+def test_fake_backend_always_verifies():
+    f = bls.backend("fake")
+    sk, pk = f.interop_keypair(3)
+    sig = f.SecretKey.random().sign(b"\x00" * 32)
+    assert sig.verify(pk, b"unrelated message..............00")
+    # serialization-stable: arbitrary right-length bytes round-trip
+    blob = bytes(range(96))
+    assert f.Signature.from_bytes(blob).to_bytes() == blob
+    with pytest.raises(f.DecodeError):
+        f.Signature.from_bytes(b"short")
+    with pytest.raises(f.DecodeError):
+        f.SecretKey.from_bytes(bytes(32))  # zero secret key rejected
+
+
+def test_fake_eth_fast_aggregate_infinity_special_case():
+    f = bls.backend("fake")
+    assert f.Signature.infinity().eth_fast_aggregate_verify([], b"\x00" * 32)
+    assert not f.Signature.infinity().fast_aggregate_verify([], b"\x00" * 32)
