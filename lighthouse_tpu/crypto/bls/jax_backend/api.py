@@ -1,0 +1,376 @@
+"""The `jax` BLS backend: batched, device-resident signature verification.
+
+This is the accelerated counterpart of the reference's blst backend
+(/root/reference/crypto/bls/src/impls/blst.rs). The verification workload —
+hash-to-G2, subgroup checks, random-linear-combination accumulation, Miller
+loops, one final exponentiation — runs as a single jitted XLA program per
+(batch-size, keys-per-set) bucket:
+
+    host:   expand_message_xmd (SHA-256), point decompression (no subgroup
+            check — deferred to the device), RLC scalar sampling, packing
+    device: SSWU/isogeny/cofactor hash-to-G2; psi-criterion subgroup checks
+            for every signature; G1 ladders for r_i * aggpk_i; G2 ladders
+            for r_i * sig_i; n+1 Miller loops; one final exponentiation
+
+Semantics match the reference exactly (impls/blst.rs:36-119):
+  - independent nonzero 64-bit scalars per set (RAND_BITS = 64)
+  - empty batch and empty signing_keys are failures
+  - infinity public keys are rejected (lib.rs:61-64)
+  - signatures are subgroup-checked (device, Scott psi criterion)
+
+Deliberate deviation: `Signature.from_bytes` here defers the subgroup check
+to verification time (the device batch does it for free); the oracle checks
+at deserialization. Both reject non-subgroup signatures before they count.
+
+Batch shapes are bucketed to powers of two to bound XLA recompilation; the
+compiled programs are cached in-process and in the persistent JAX cache.
+"""
+
+from __future__ import annotations
+
+import secrets
+from dataclasses import dataclass
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..constants import DST
+from ..ref import api as _ref
+from ..ref.curves import Point, g1_infinity, g2_infinity
+
+# Re-used host-side types (serialization, keys, signing).
+DecodeError = _ref.DecodeError
+PublicKey = _ref.PublicKey
+RAND_BITS = _ref.RAND_BITS
+
+aggregate_public_keys = _ref.aggregate_public_keys
+interop_secret_key_ref = _ref.interop_secret_key
+
+
+class Signature(_ref.Signature):
+    """Signature whose verification runs on the accelerator.
+
+    from_bytes decompresses and on-curve-checks on the host but defers the
+    subgroup check to the device batch (see module docstring)."""
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "Signature":
+        return Signature(_ref.g2_from_compressed(data, subgroup_check=False))
+
+    @staticmethod
+    def infinity() -> "Signature":
+        return Signature(g2_infinity())
+
+    def verify(self, pk: PublicKey, message: bytes) -> bool:
+        return self.fast_aggregate_verify([pk], message)
+
+    def fast_aggregate_verify(self, pks: list[PublicKey], message: bytes) -> bool:
+        if not pks:
+            return False
+        s = SignatureSet(signature=self, signing_keys=list(pks), message=message)
+        return verify_signature_sets([s], rng=_ONE_RNG)
+
+    def aggregate_verify(self, pks: list[PublicKey], messages: list[bytes]) -> bool:
+        """Distinct-message aggregate verify (impls/blst.rs:246-257), mapped
+        onto the batch kernel: n sets with r_i = 1; the aggregate signature
+        rides on the first set, the rest carry infinity (sum = sig)."""
+        if not pks or len(pks) != len(messages):
+            return False
+        sets = [
+            SignatureSet(
+                signature=self if i == 0 else Signature.infinity(),
+                signing_keys=[pk],
+                message=msg,
+            )
+            for i, (pk, msg) in enumerate(zip(pks, messages))
+        ]
+        return verify_signature_sets(sets, rng=_ONE_RNG)
+
+    def eth_fast_aggregate_verify(self, pks: list[PublicKey], message: bytes) -> bool:
+        if not pks and self.is_infinity():
+            return True
+        return self.fast_aggregate_verify(pks, message)
+
+
+class SecretKey(_ref.SecretKey):
+    def sign(self, message: bytes) -> Signature:
+        return Signature(super().sign(message).point)
+
+    @staticmethod
+    def from_bytes(data: bytes) -> "SecretKey":
+        if len(data) != 32:
+            raise DecodeError("secret key must be 32 bytes")
+        return SecretKey(int.from_bytes(data, "big"))
+
+    @staticmethod
+    def random() -> "SecretKey":
+        return SecretKey(secrets.randbelow(_ref.R - 1) + 1)
+
+    def public_key(self) -> PublicKey:
+        return PublicKey(_ref.g1_generator().mul(self.k))
+
+
+def aggregate_signatures(sigs: list["Signature"]) -> "Signature":
+    if not sigs:
+        raise ValueError("cannot aggregate empty signature list")
+    acc = g2_infinity()
+    for s in sigs:
+        acc = acc + s.point
+    return Signature(acc)
+
+
+@dataclass
+class SignatureSet:
+    """{signature, signing_keys, message} — mirrors
+    /root/reference/crypto/bls/src/generic_signature_set.rs:61-72."""
+
+    signature: Signature
+    signing_keys: list[PublicKey]
+    message: bytes
+
+
+def verify_signature_set(s: SignatureSet) -> bool:
+    return s.signature.fast_aggregate_verify(s.signing_keys, s.message)
+
+
+# -- the device kernel ---------------------------------------------------------
+
+
+def _next_pow2(n: int, floor: int = 4) -> int:
+    """Bucket size: next power of two, floored at 4 so that single-set
+    verifies share the small-batch compiled kernel instead of each (S, K)
+    shape compiling its own program."""
+    return max(floor, 1 << max(0, (n - 1)).bit_length())
+
+
+def verify_pipeline_local(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits):
+    """The per-shard verification pipeline: everything except the final
+    exponentiation, for S_local sets x K keys/set.
+
+    Returns (miller_partial, ok_flags): the product of the local Miller
+    values INCLUDING this shard's own (-g1, sum_local r_i sig_i) pair, and
+    the AND of local subgroup/infinity checks. Partial products from
+    different shards just multiply:
+        prod_shards e(-g1, sum_local r s) = e(-g1, sum_global r s),
+    so multi-chip reduction is an all-gather of one Fp12 per shard followed
+    by one replicated final exponentiation (SURVEY.md §2.8 item 1).
+
+    Single-chip callers multiply nothing: final_exponentiation(partial).
+    """
+    from . import h2c, pairing
+    from .curve import (
+        FP,
+        FP2,
+        Proj,
+        _stack2,
+        add as p_add,
+        eq_points,
+        from_affine,
+        is_infinity,
+        neg as p_neg,
+        psi,
+        scalar_mul_bits,
+        to_affine,
+    )
+    from .pack import G1_GEN_X_L, G1_GEN_NEG_Y_L
+    from jax import lax
+
+    S, K = pk_inf.shape
+
+    # 1. Hash messages to G2 (device algebra; host already did SHA-256).
+    H = h2c.hash_to_g2_device(u)  # Proj batch (S,)
+
+    # 2. Aggregate each set's pubkeys (scan-fold over the K axis).
+    pks = from_affine(FP, pk_x, pk_y, pk_inf)  # (S, K) batch
+    if K == 1:
+        agg = Proj(pks.x[:, 0], pks.y[:, 0], pks.z[:, 0])
+    else:
+        def fold(acc, nxt):
+            return p_add(FP, acc, nxt), None
+
+        xs = Proj(
+            jnp.moveaxis(pks.x, 1, 0), jnp.moveaxis(pks.y, 1, 0), jnp.moveaxis(pks.z, 1, 0)
+        )
+        first = Proj(xs.x[0], xs.y[0], xs.z[0])
+        rest = Proj(xs.x[1:], xs.y[1:], xs.z[1:])
+        agg, _ = lax.scan(fold, first, rest)
+    agg_inf = is_infinity(FP, agg)  # aggregate == infinity => invalid
+
+    # 3. r_i * aggpk_i (G1 ladders, per-set 64-bit scalars).
+    r_pk = scalar_mul_bits(FP, agg, r_bits)
+
+    # 4. G2: subgroup checks (psi criterion: psi(sig) == -[|z|]sig) and
+    #    r_i * sig_i — their ladders share ONE 2-stacked instantiation.
+    sigs = from_affine(FP2, sig_x, sig_y, sig_inf)
+    absx = jnp.broadcast_to(jnp.asarray(pairing._ABS_X_BITS_MSB[-64:]), r_bits.shape)
+    both = scalar_mul_bits(FP2, _stack2(FP2, sigs, sigs), jnp.stack([absx, r_bits]))
+    zsig = Proj(both.x[0], both.y[0], both.z[0])  # [|z|] sig
+    rsig = Proj(both.x[1], both.y[1], both.z[1])  # [r] sig
+    sub_ok = eq_points(FP2, psi(sigs), p_neg(FP2, zsig)) | is_infinity(FP2, sigs)
+
+    # 5. sig_acc = sum_i r_i sig_i (scan-fold over local S).
+    first = Proj(rsig.x[0], rsig.y[0], rsig.z[0])
+    if S == 1:
+        sig_acc = first
+    else:
+        def fold2(acc, nxt):
+            return p_add(FP2, acc, nxt), None
+
+        rest = Proj(rsig.x[1:], rsig.y[1:], rsig.z[1:])
+        sig_acc, _ = lax.scan(fold2, first, rest)
+
+    # 6. S+1 Miller pairs: (r_i aggpk_i, H_i) and (-g1, local sig_acc).
+    pk_ax, pk_ay, pk_ainf = to_affine(FP, r_pk)
+    h_ax, h_ay, h_ainf = to_affine(FP2, H)
+    sa_x, sa_y, sa_inf = to_affine(FP2, sig_acc)
+    px = jnp.concatenate([pk_ax, jnp.asarray(G1_GEN_X_L)[None]], axis=0)
+    py = jnp.concatenate([pk_ay, jnp.asarray(G1_GEN_NEG_Y_L)[None]], axis=0)
+    p_in = jnp.concatenate([pk_ainf, jnp.zeros(1, bool)])
+    qx = jnp.concatenate([h_ax, sa_x[None]], axis=0)
+    qy = jnp.concatenate([h_ay, sa_y[None]], axis=0)
+    q_in = jnp.concatenate([h_ainf, sa_inf[None]])
+
+    f = pairing.miller_loop(px, py, p_in, qx, qy, q_in)
+    partial = pairing.product_reduce(f)
+    ok_flags = jnp.all(sub_ok) & ~jnp.any(agg_inf)
+    return partial, ok_flags
+
+
+@lru_cache(maxsize=32)
+def _verify_kernel(S: int, K: int):
+    """Build the jitted single-chip batch-verify program."""
+    from . import pairing
+    from .tower import fp12_is_one
+
+    def kernel(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits):
+        partial, ok_flags = verify_pipeline_local(
+            pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits
+        )
+        gt = pairing.final_exponentiation(partial)
+        return fp12_is_one(gt) & ok_flags
+
+    return jax.jit(kernel)
+
+
+_ONE_RNG = "ones"  # sentinel: r_i = 1 (single-set / aggregate-verify paths)
+
+
+def _scalar_bits(r: int) -> np.ndarray:
+    return np.array([(r >> (63 - i)) & 1 for i in range(64)], dtype=np.int32)
+
+
+def stage_sets(sets: list[SignatureSet], rng=None, s_floor: int = 4):
+    """Host staging for the device kernels: pad the batch to the S bucket
+    (pow2, >= s_floor) with (generator-keyed, r=0) no-op sets and each key
+    list to the K bucket with infinity points (additive identity). Returns
+    (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits) numpy arrays."""
+    from . import h2c
+    from .pack import pack_g1_batch, pack_g2_batch
+
+    S = _next_pow2(len(sets), floor=max(4, s_floor))
+    K = _next_pow2(max(len(s.signing_keys) for s in sets))
+
+    pk_pts: list[Point] = []
+    sig_pts: list[Point] = []
+    msgs: list[bytes] = []
+    r_rows = np.zeros((S, 64), dtype=np.int32)
+    gen = _ref.g1_generator()
+    for i in range(S):
+        if i < len(sets):
+            s = sets[i]
+            keys = [pk.point for pk in s.signing_keys]
+            keys += [g1_infinity()] * (K - len(keys))
+            pk_pts.extend(keys)
+            sig_pts.append(s.signature.point)
+            msgs.append(s.message)
+            if rng is _ONE_RNG:
+                r = 1
+            else:
+                rand = rng if rng is not None else secrets.randbits
+                r = 0
+                while r == 0:
+                    r = rand(RAND_BITS)
+            r_rows[i] = _scalar_bits(r)
+        else:
+            pk_pts.extend([gen] + [g1_infinity()] * (K - 1))
+            sig_pts.append(g2_infinity())
+            msgs.append(b"")
+            # r stays 0: the padded set contributes the identity everywhere.
+
+    pk_x, pk_y, pk_inf = pack_g1_batch(pk_pts)
+    pk_x = pk_x.reshape(S, K, -1)
+    pk_y = pk_y.reshape(S, K, -1)
+    pk_inf = pk_inf.reshape(S, K)
+    sig_x, sig_y, sig_inf = pack_g2_batch(sig_pts)
+    u = h2c.hash_to_field_limbs(msgs)
+    return pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_rows
+
+
+def verify_signature_sets(sets: list[SignatureSet], rng=None) -> bool:
+    """Batch verification by random linear combination, device-executed.
+
+    Mirrors impls/blst.rs:36-119: nonzero 64-bit scalars, n+1 Miller loops,
+    one final exponentiation. Returns False (never raises) for structurally
+    invalid batches, like the reference."""
+    if not sets:
+        return False
+    for s in sets:
+        if not s.signing_keys:
+            return False
+        if any(pk.point.inf for pk in s.signing_keys):
+            return False
+
+    staged = stage_sets(sets, rng=rng)
+    kernel = _verify_kernel(staged[2].shape[0], staged[2].shape[1])
+    return bool(kernel(*(jnp.asarray(a) for a in staged)))
+
+
+# -- pubkey validation (cache-admission path) ----------------------------------
+
+
+@lru_cache(maxsize=8)
+def _pk_validate_kernel(S: int):
+    from .curve import FP, from_affine, g1_in_subgroup
+
+    def kernel(x, y, inf):
+        return g1_in_subgroup(from_affine(FP, x, y, inf)) & ~inf
+
+    return jax.jit(kernel)
+
+
+def batch_validate_public_keys(keys: list[bytes]) -> list[bool]:
+    """Decompress + full subgroup-check a batch of compressed G1 pubkeys on
+    device — the ValidatorPubkeyCache admission path
+    (/root/reference/beacon_node/beacon_chain/src/validator_pubkey_cache.rs).
+    Returns one bool per key; structurally invalid encodings are False."""
+    from .pack import pack_g1_batch
+
+    pts = []
+    ok_mask = []
+    for kb in keys:
+        try:
+            pts.append(_ref.g1_from_compressed(kb, subgroup_check=False))
+            ok_mask.append(True)
+        except DecodeError:
+            pts.append(g1_infinity())
+            ok_mask.append(False)
+    S = _next_pow2(len(pts))
+    pts += [g1_infinity()] * (S - len(pts))
+    x, y, inf = pack_g1_batch(pts)
+    res = np.asarray(_pk_validate_kernel(S)(jnp.asarray(x), jnp.asarray(y), jnp.asarray(inf)))
+    return [bool(r) and m for r, m in zip(res[: len(keys)], ok_mask)]
+
+
+# -- interop keypairs ----------------------------------------------------------
+
+
+def interop_secret_key(validator_index: int) -> SecretKey:
+    return SecretKey(_ref.interop_secret_key(validator_index).k)
+
+
+def interop_keypair(validator_index: int) -> tuple[SecretKey, PublicKey]:
+    sk = interop_secret_key(validator_index)
+    return sk, sk.public_key()

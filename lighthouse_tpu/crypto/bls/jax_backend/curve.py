@@ -1,0 +1,290 @@
+"""Branch-free projective curve arithmetic for G1 (over Fp) and G2 (over Fp2).
+
+Device-side equivalent of the point types blst provides to the reference
+(/root/reference/crypto/bls/src/generic_public_key.rs, generic_signature.rs).
+
+Design notes (TPU-first):
+  - Homogeneous projective coordinates (X : Y : Z), infinity = (0 : 1 : 0),
+    with the *complete* addition formulas of Renes–Costello–Batina 2016
+    (Algorithm 7, a = 0): one formula covers doubling, inverses, and
+    infinity with zero exceptional branches — ideal for XLA, where a select
+    cascade over exceptional cases would double the graph and the work.
+  - Scalar multiplication is a Montgomery ladder whose body performs BOTH
+    ladder operations (R0+R1 and 2*R_b) as ONE complete addition on a
+    2-stacked operand — one add instantiation per step keeps the compiled
+    scan body small.
+  - Generic over the coordinate field via the `FieldOps` adapter, mirroring
+    the oracle's generic `Point` (ref/curves.py:18-27).
+  - G2 subgroup membership uses the psi-endomorphism criterion
+    (M. Scott, "A note on group membership tests for G1, G2 and GT", 2021):
+    P in G2 <=> psi(P) == [z]P (z = BLS parameter, negative here) — a 64-bit
+    ladder instead of a 255-bit one; differentially validated against the
+    oracle's full-order check in tests (positives and negatives).
+
+Correctness of the complete formulas and ladder is established by the
+differential suite against the pure-Python oracle: random pairs, P+P,
+P+(-P), either-infinity, both-infinity, and scalar-mul known answers.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..constants import B_G1, B_G2, R as R_ORD, X as X_PARAM
+from . import fp, tower
+from .tower import fp2_conj, fp2_mul
+
+
+class FieldOps(NamedTuple):
+    """Uniform field interface for the generic group law."""
+
+    add: callable
+    sub: callable
+    neg: callable
+    mul: callable
+    sqr: callable
+    inv: callable
+    is_zero: callable
+    eq: callable
+    select: callable
+    one: callable  # shape -> broadcasted one
+    zero: callable
+    b3: np.ndarray  # 3*b curve constant, Montgomery-packed
+
+
+def _b3_g1() -> np.ndarray:
+    return fp.to_mont_host(3 * B_G1)
+
+
+def _b3_g2() -> np.ndarray:
+    from .pack import pack_fp2
+
+    return pack_fp2(3 * B_G2[0], 3 * B_G2[1])
+
+
+FP = FieldOps(
+    add=fp.add,
+    sub=fp.sub,
+    neg=fp.neg,
+    mul=fp.mul,
+    sqr=fp.sqr,
+    inv=fp.inv,
+    is_zero=fp.is_zero,
+    eq=fp.eq,
+    select=fp.select,
+    one=lambda shape=(): jnp.broadcast_to(jnp.asarray(fp.ONE_MONT), (*shape, fp.N_LIMBS)),
+    zero=lambda shape=(): jnp.zeros((*shape, fp.N_LIMBS), jnp.int32),
+    b3=_b3_g1(),
+)
+
+FP2 = FieldOps(
+    add=tower.fp2_add,
+    sub=tower.fp2_sub,
+    neg=tower.fp2_neg,
+    mul=tower.fp2_mul,
+    sqr=tower.fp2_sqr,
+    inv=tower.fp2_inv,
+    is_zero=tower.fp2_is_zero,
+    eq=tower.fp2_eq,
+    select=tower.fp2_select,
+    one=tower.fp2_one,
+    zero=tower.fp2_zero,
+    b3=_b3_g2(),
+)
+
+
+class Proj(NamedTuple):
+    """A (batch of) homogeneous projective point(s); arrays share batch dims."""
+
+    x: jnp.ndarray
+    y: jnp.ndarray
+    z: jnp.ndarray
+
+
+def from_affine(F: FieldOps, x, y, inf):
+    """Affine coords + infinity mask -> projective; infinity = (0, 1, 0)."""
+    shape = jnp.asarray(inf).shape
+    one = F.one(shape)
+    zero = F.zero(shape)
+    return Proj(
+        F.select(inf, zero, x),
+        F.select(inf, one, y),
+        F.select(inf, zero, one),
+    )
+
+
+def to_affine(F: FieldOps, p: Proj):
+    """Return (x, y, inf); infinity decodes to zeroed coords (inv0)."""
+    zinv = F.inv(p.z)
+    return F.mul(p.x, zinv), F.mul(p.y, zinv), F.is_zero(p.z)
+
+
+def is_infinity(F: FieldOps, p: Proj):
+    return F.is_zero(p.z)
+
+
+def infinity(F: FieldOps, shape=()):
+    return Proj(F.zero(shape), F.one(shape), F.zero(shape))
+
+
+def neg(F: FieldOps, p: Proj) -> Proj:
+    return Proj(p.x, F.neg(p.y), p.z)
+
+
+def add(F: FieldOps, p: Proj, q: Proj) -> Proj:
+    """Complete addition, RCB 2016 Algorithm 7 (a = 0, b3 = 3b). Valid for
+    ALL input pairs including P == Q, P == -Q, and infinity."""
+    b3 = jnp.asarray(F.b3)
+    x1, y1, z1 = p
+    x2, y2, z2 = q
+    t0 = F.mul(x1, x2)
+    t1 = F.mul(y1, y2)
+    t2 = F.mul(z1, z2)
+    t3 = F.mul(F.add(x1, y1), F.add(x2, y2))
+    t3 = F.sub(t3, F.add(t0, t1))  # x1y2 + x2y1
+    t4 = F.mul(F.add(y1, z1), F.add(y2, z2))
+    t4 = F.sub(t4, F.add(t1, t2))  # y1z2 + y2z1
+    x3 = F.mul(F.add(x1, z1), F.add(x2, z2))
+    y3 = F.sub(x3, F.add(t0, t2))  # x1z2 + x2z1
+    x3 = F.add(t0, t0)
+    t0 = F.add(x3, t0)  # 3*x1x2
+    t2 = F.mul(b3, t2)  # 3b*z1z2
+    z3 = F.add(t1, t2)
+    t1 = F.sub(t1, t2)
+    y3 = F.mul(b3, y3)  # 3b*(x1z2 + x2z1)
+    x3 = F.mul(t4, y3)
+    t2 = F.mul(t3, t1)
+    x3 = F.sub(t2, x3)
+    y3 = F.mul(y3, t0)
+    t1 = F.mul(t1, z3)
+    y3 = F.add(t1, y3)
+    t0 = F.mul(t0, t3)
+    z3 = F.mul(z3, t4)
+    z3 = F.add(z3, t0)
+    return Proj(x3, y3, z3)
+
+
+def dbl(F: FieldOps, p: Proj) -> Proj:
+    return add(F, p, p)
+
+
+def _sel(F: FieldOps, cond, a: Proj, b: Proj) -> Proj:
+    return Proj(F.select(cond, a.x, b.x), F.select(cond, a.y, b.y), F.select(cond, a.z, b.z))
+
+
+def _stack2(F: FieldOps, a: Proj, b: Proj) -> Proj:
+    return Proj(
+        jnp.stack([a.x, b.x]), jnp.stack([a.y, b.y]), jnp.stack([a.z, b.z])
+    )
+
+
+def scalar_mul_bits(F: FieldOps, p: Proj, bits: jnp.ndarray) -> Proj:
+    """Montgomery ladder, MSB-first over a fixed bit width.
+
+    bits: (n_bits,) static table (public scalar, broadcast over the batch) or
+    (..., n_bits) traced array of 0/1 (per-element scalars). The ladder body
+    computes R0+R1 and 2*R_b as ONE 2-stacked complete addition.
+    """
+    bits = jnp.asarray(bits)
+    shape = jnp.asarray(F.is_zero(p.z)).shape
+    r0 = infinity(F, shape)
+    r1 = p
+    if bits.ndim == 1:
+        xs = bits
+    else:
+        xs = jnp.moveaxis(bits, -1, 0)  # (n_bits, ...)
+
+    def step(carry, bit):
+        r0, r1 = carry
+        take = jnp.broadcast_to(bit != 0, shape)
+        rsel = _sel(F, take, r1, r0)
+        u = add(F, _stack2(F, r0, rsel), _stack2(F, r1, rsel))
+        u_add = Proj(u.x[0], u.y[0], u.z[0])  # R0 + R1
+        u_dbl = Proj(u.x[1], u.y[1], u.z[1])  # 2 * R_b
+        r0n = _sel(F, take, u_add, u_dbl)
+        r1n = _sel(F, take, u_dbl, u_add)
+        return (r0n, r1n), None
+
+    (r0, _), _ = lax.scan(step, (r0, r1), xs)
+    return r0
+
+
+def scalar_mul_int(F: FieldOps, p: Proj, k: int, width: int | None = None) -> Proj:
+    """Fixed public scalar (host int -> static bit table); negatives negate."""
+    if k < 0:
+        return neg(F, scalar_mul_int(F, p, -k, width))
+    w = width or max(1, k.bit_length())
+    bits = np.array([(k >> (w - 1 - i)) & 1 for i in range(w)], dtype=np.int32)
+    return scalar_mul_bits(F, p, bits)
+
+
+def eq_points(F: FieldOps, p: Proj, q: Proj):
+    """Projective-class equality (cross-multiplied); correct for canonical
+    infinity (0, y, 0) against finite points and other infinities."""
+    x_eq = F.eq(F.mul(p.x, q.z), F.mul(q.x, p.z))
+    y_eq = F.eq(F.mul(p.y, q.z), F.mul(q.y, p.z))
+    p_inf = F.is_zero(p.z)
+    q_inf = F.is_zero(q.z)
+    return (p_inf & q_inf) | (~p_inf & ~q_inf & x_eq & y_eq)
+
+
+# -- psi endomorphism & subgroup checks ---------------------------------------
+
+# psi(x, y) = (conj(x) * CX, conj(y) * CY) with CX = 1/h^2, CY = 1/h^3,
+# h = xi^((p-1)/6) — same constants as the oracle
+# (lighthouse_tpu/crypto/bls/ref/hash_to_curve.py:284-295).
+
+
+def _psi_constants():
+    from ..ref.hash_to_curve import _PSI_CX, _PSI_CY
+    from .pack import pack_fp2_el
+
+    return pack_fp2_el(_PSI_CX), pack_fp2_el(_PSI_CY)
+
+
+_PSI_CX_L, _PSI_CY_L = _psi_constants()
+
+
+def psi(p: Proj) -> Proj:
+    """Untwist-Frobenius-twist endomorphism in homogeneous coordinates:
+    conjugate all coordinates, scale x and y by the psi constants."""
+    return Proj(
+        fp2_mul(fp2_conj(p.x), jnp.asarray(_PSI_CX_L)),
+        fp2_mul(fp2_conj(p.y), jnp.asarray(_PSI_CY_L)),
+        fp2_conj(p.z),
+    )
+
+
+_ABS_X_BITS = np.array([(abs(X_PARAM) >> (63 - i)) & 1 for i in range(64)], dtype=np.int32)
+_R_BITS = np.array([(R_ORD >> (254 - i)) & 1 for i in range(255)], dtype=np.int32)
+
+
+def g2_in_subgroup(p: Proj):
+    """Scott's psi criterion: P in G2 iff psi(P) == [z]P (z = X < 0, so
+    psi(P) == -[|z|]P). Infinity is in the subgroup. ~4x cheaper than the
+    full-order check; validated against the oracle in tests."""
+    lhs = psi(p)
+    rhs = neg(FP2, scalar_mul_bits(FP2, p, _ABS_X_BITS))
+    return eq_points(FP2, lhs, rhs) | is_infinity(FP2, p)
+
+
+def g1_in_subgroup(p: Proj):
+    """Full-order check [r]P == O. Used for pubkey-cache admission only
+    (amortized once per validator, mirroring the reference's decompress-once
+    ValidatorPubkeyCache, /root/reference/beacon_node/beacon_chain/src/
+    validator_pubkey_cache.rs:12-37)."""
+    return is_infinity(FP, scalar_mul_bits(FP, p, _R_BITS))
+
+
+def g2_in_subgroup_full(p: Proj):
+    """Full-order check for G2 — the oracle-grade criterion the psi test is
+    validated against."""
+    return is_infinity(FP2, scalar_mul_bits(FP2, p, _R_BITS))
+
+
+# Backwards-compatible alias: earlier code calls the point container "Jac".
+Jac = Proj

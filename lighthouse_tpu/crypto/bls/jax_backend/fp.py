@@ -1,0 +1,344 @@
+"""Base-field (Fp, p = BLS12-381 modulus) arithmetic on TPU-friendly limbs.
+
+This is the foundation of the accelerated verifier — the role blst's
+assembly field arithmetic plays for the reference
+(/root/reference/crypto/bls/src/impls/blst.rs:9, the external blst dep).
+
+Representation
+--------------
+An Fp element is a length-32 vector of 12-bit limbs in an int32 lane
+(little-endian limb order): value = sum(limbs[i] << (12*i)), 32*12 = 384 bits
+>= 381. All stored values are *canonical*: limbs in [0, 2^12), value < p, and
+kept in Montgomery form (x~ = x * 2^384 mod p) between operations.
+
+Why 12-bit limbs on int32: the TPU VPU has no native 64-bit multiply, and XLA
+emulates int64 slowly; with 12-bit limbs every schoolbook column sum is
+bounded by 32 * (2^12)^2 = 2^29 and a Montgomery accumulation adds at most
+another 2^29 + carries, so everything fits int32 with headroom — no int64
+anywhere on the hot path.
+
+Shapes: every function broadcasts over arbitrary leading batch dimensions;
+an element is (..., 32) int32. Batched verification therefore needs no vmap —
+batching is ordinary array broadcasting, which XLA fuses well.
+
+All functions are pure and jit-safe (static shapes, no Python branching on
+traced values).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..constants import P
+
+LIMB_BITS = 12
+N_LIMBS = 32
+LIMB_MASK = (1 << LIMB_BITS) - 1
+BITS = LIMB_BITS * N_LIMBS  # 384
+
+# -- host-side packing ---------------------------------------------------------
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Pack a Python int in [0, 2^384) into little-endian 12-bit limbs."""
+    if not 0 <= x < (1 << BITS):
+        raise ValueError("value out of limb range")
+    return np.array([(x >> (LIMB_BITS * i)) & LIMB_MASK for i in range(N_LIMBS)], dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    arr = np.asarray(limbs, dtype=np.int64)
+    return sum(int(arr[..., i]) << (LIMB_BITS * i) for i in range(arr.shape[-1]))
+
+
+# -- Montgomery constants (host-precomputed Python bigints) --------------------
+
+R_MONT = (1 << BITS) % P  # 2^384 mod p
+R2 = (R_MONT * R_MONT) % P
+N_PRIME = (-pow(P, -1, 1 << BITS)) % (1 << BITS)  # -p^-1 mod 2^384
+
+P_LIMBS = int_to_limbs(P)
+N_PRIME_LIMBS = int_to_limbs(N_PRIME)
+R2_LIMBS = int_to_limbs(R2)
+ONE_MONT = int_to_limbs(R_MONT)  # 1 in Montgomery form
+ZERO = np.zeros(N_LIMBS, dtype=np.int32)
+
+# Exponent bit tables (MSB-first) for fixed-exponent powers.
+_INV_EXP_BITS = np.array([int(b) for b in bin(P - 2)[2:]], dtype=np.int32)
+_SQRT_EXP_BITS = np.array([int(b) for b in bin((P + 1) // 4)[2:]], dtype=np.int32)
+
+
+def to_mont_host(x: int) -> np.ndarray:
+    """Host-side conversion to Montgomery-form limbs (for constants)."""
+    return int_to_limbs((x % P) * R_MONT % P)
+
+
+def from_mont_host(limbs) -> int:
+    """Host-side conversion from Montgomery-form limbs to a Python int."""
+    rinv = pow(R_MONT, -1, P)
+    return limbs_to_int(limbs) * rinv % P
+
+
+# -- carry machinery -----------------------------------------------------------
+
+
+def _carry_scan(cols: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Normalize column sums to canonical limbs — fully vectorized, no
+    sequential loop (a naive per-limb `lax.scan` ripple nests a While loop
+    inside every field op, which makes Miller-loop-sized graphs uncompilable).
+
+    Scheme: three shift-add passes shrink per-position carries from |c|<2^18
+    to c in {-1, 0, +1}; the residual ±1 ripple (which can cascade across all
+    limbs in the worst case) is resolved *exactly* with a log-depth
+    `associative_scan` over the carry-transfer monoid: each position becomes
+    the function {-1,0,1} -> {-1,0,1} mapping carry-in to carry-out, and
+    function composition is associative.
+
+    cols: (..., K) int32 column values, |value| < 2^30 (signed ok).
+    Returns (limbs (..., K) in [0, 2^12), final_carry (...,)) — negative
+    totals yield a negative final carry (used as a borrow flag).
+    """
+    pad_cfg = [(0, 0)] * (cols.ndim - 1) + [(1, 0)]
+    carry_out = jnp.zeros(cols.shape[:-1], jnp.int32)
+    v = cols
+    for _ in range(3):  # carries: 2^18 -> 65 -> 1
+        c = v >> LIMB_BITS
+        v = (v & LIMB_MASK) + jnp.pad(c, pad_cfg)[..., :-1]
+        carry_out = carry_out + c[..., -1]
+    # v in [-1, 4096]; per-position carry function of carry-in in {-1,0,+1},
+    # resolved with a hand-rolled Kogge-Stone prefix composition (compiles to
+    # a handful of flat shift/select ops per level; log2(K) levels).
+    f = jnp.stack([(v - 1) >> LIMB_BITS, v >> LIMB_BITS, (v + 1) >> LIMB_BITS], axis=-1)
+    K = f.shape[-2]
+    ident = jnp.broadcast_to(jnp.asarray(np.array([-1, 0, 1], np.int32)), f.shape)
+    F = f
+    d = 1
+    while d < K:
+        # prefix at i composes with prefix ending at i-d (identity below 0)
+        earlier = jnp.concatenate([ident[..., :d, :], F[..., :-d, :]], axis=-2)
+        rm1, r0, rp1 = F[..., 0:1], F[..., 1:2], F[..., 2:3]
+        F = jnp.where(earlier == -1, rm1, jnp.where(earlier == 0, r0, rp1))
+        d *= 2
+    zero_in = F[..., 1]  # carry-out at each position for overall carry-in 0
+    c_in = jnp.pad(zero_in, pad_cfg)[..., :-1]
+    limbs = (v + c_in) & LIMB_MASK
+    return limbs, carry_out + zero_in[..., -1]
+
+
+def _cond_sub(x: jnp.ndarray) -> jnp.ndarray:
+    """Return x - p if x >= p else x, for canonical-limbed x < 2p < 2^383.
+
+    Every caller's input is provably < 2p (Montgomery output bound / sum of
+    two canonical elements), so a single conditional subtraction canonicalizes.
+    """
+    diff, borrow = _carry_scan(x - jnp.asarray(P_LIMBS))
+    take_diff = (borrow == 0)[..., None]
+    return jnp.where(take_diff, diff, x)
+
+
+# -- schoolbook column product -------------------------------------------------
+
+
+def _poly_mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Column sums of the 32x32 limb product, shape (..., 63), each < 2^29.
+
+    Anti-diagonal summation is done with the pad/reshape trick (pad each row
+    of the outer product to length 64, flatten, drop the tail, reshape) so the
+    whole product is a handful of fused elementwise/reshape ops — no gather,
+    no scatter, no sequential loop.
+    """
+    outer = a[..., :, None] * b[..., None, :]  # (..., 32, 32)
+    padded = jnp.pad(outer, [(0, 0)] * (outer.ndim - 2) + [(0, 0), (0, N_LIMBS)])
+    flat = padded.reshape(padded.shape[:-2] + (N_LIMBS * 2 * N_LIMBS,))
+    flat = flat[..., : N_LIMBS * 2 * N_LIMBS - N_LIMBS]
+    skew = flat.reshape(flat.shape[:-1] + (N_LIMBS, 2 * N_LIMBS - 1))
+    return jnp.sum(skew, axis=-2)
+
+
+# -- lazy-reduction machinery --------------------------------------------------
+#
+# The tower fields (tower.py) do NOT reduce after every Fp product: they
+# compute all schoolbook column products of an extension-field operation in
+# ONE stacked `poly` call, combine them with plain (cheap, carry-free) column
+# arithmetic, and finish with ONE stacked `redc` — so an Fp12 multiply costs
+# a single Montgomery-reduction graph instead of 54. This is what makes the
+# Miller loop both compilable (graph size ~ ops, not ~ Fp-muls) and fast
+# (few big fused kernels instead of many small ones).
+#
+# Column-domain contracts (callers must respect; see bound notes at each op):
+#   - poly() inputs: limbs in [0, 4096]   (canonical, or one `pass1` after add)
+#   - column magnitudes stay below ~1.5 * 2^30 (int32 headroom)
+#   - redc() input VALUE must be >= 0 (add a multiple of p — e.g. OFF_2PP —
+#     before subtracting products) and < mult * p * 2^384.
+
+
+def pass1(cols: jnp.ndarray) -> jnp.ndarray:
+    """One shift-add carry pass. Shrinks column magnitude from C to
+    ~C/2^12 + 2^12. The carry out of the top column is DROPPED — callers
+    use this either where the value fits (padded arrays) or where mod-2^384
+    truncation is intended."""
+    c = cols >> LIMB_BITS
+    pad_cfg = [(0, 0)] * (cols.ndim - 1) + [(1, 0)]
+    return (cols & LIMB_MASK) + jnp.pad(c, pad_cfg)[..., :-1]
+
+
+def poly(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Unreduced 63-column product (see _poly_mul). Stack operands along a
+    leading axis to batch many products into one call."""
+    return _poly_mul(a, b)
+
+
+def _pad_to(cols: jnp.ndarray, n: int) -> jnp.ndarray:
+    k = n - cols.shape[-1]
+    if k == 0:
+        return cols
+    return jnp.pad(cols, [(0, 0)] * (cols.ndim - 1) + [(0, k)])
+
+
+def _ge(x: jnp.ndarray, y_const: np.ndarray) -> jnp.ndarray:
+    """Lexicographic x >= y for canonical-limbed operands, branch-free:
+    sign-weighted sums (split 16/16 so weights fit int32)."""
+    s = jnp.sign(x - jnp.asarray(y_const))
+    w16 = jnp.asarray(np.arange(16, dtype=np.int32))
+    hi = jnp.sum(s[..., 16:] << w16, axis=-1)
+    lo = jnp.sum(s[..., :16] << w16, axis=-1)
+    return jnp.where(hi != 0, hi, lo) >= 0
+
+
+_JP_TABLES = [int_to_limbs(j * P) for j in range(1, 8)]  # j*p digit tables
+
+# p's digits aligned at the 2^384 boundary (the redc quotient guard).
+_P_HIGH_ALIGNED = np.concatenate([np.zeros(N_LIMBS, np.int32), P_LIMBS])
+
+
+def canonicalize(x: jnp.ndarray, mult: int) -> jnp.ndarray:
+    """Reduce canonical-limbed x with value < mult*p to value < p by
+    subtracting the right multiple of p (compare-select, one exact carry
+    resolution regardless of mult)."""
+    if mult <= 1:
+        return x
+    assert mult <= 8, "canonicalize supports values < 8p"
+    sel = jnp.zeros_like(x)
+    jstar = jnp.zeros(x.shape[:-1], jnp.int32)
+    for j in range(1, mult):
+        jstar = jstar + _ge(x, _JP_TABLES[j - 1]).astype(jnp.int32)
+    for j in range(1, mult):
+        sel = sel + jnp.where((jstar == j)[..., None], jnp.asarray(_JP_TABLES[j - 1]), 0)
+    d, _ = _carry_scan(x - sel)
+    return d
+
+
+def redc(cols: jnp.ndarray, mult: int = 2) -> jnp.ndarray:
+    """Montgomery-reduce unreduced columns: value * 2^-384 mod p, canonical.
+
+    cols: (..., 63 or 64) int32 columns, |col| <= ~1.5*2^30, representing a
+    NONNEGATIVE value < mult * p * 2^384.
+    """
+    cols = _pad_to(cols, 2 * N_LIMBS)
+    # Two shift-add passes suffice for `lo`: only its value mod 2^384 and a
+    # <= 4160 limb-magnitude bound matter (not canonical digits), see pass1.
+    lo = pass1(pass1(cols[..., :N_LIMBS]))
+    m = pass1(pass1(_poly_mul(lo, jnp.asarray(N_PRIME_LIMBS))[..., :N_LIMBS]))
+    # lo/m limbs may be slightly negative (signed passes), making the exact
+    # quotient as low as -p/63; the +p*2^384 guard (high-aligned P digits)
+    # keeps it nonnegative. Costs one extra p in the output bound.
+    t_all = cols + _pad_to(_poly_mul(m, jnp.asarray(P_LIMBS)), 2 * N_LIMBS) + jnp.asarray(
+        _P_HIGH_ALIGNED
+    )
+    t, _ = _carry_scan(t_all)  # (value + m*p + p*2^384) / 2^384, exact
+    return canonicalize(t[..., N_LIMBS:], mult + 1)
+
+
+# Digits of 2*p^2: the canonical "lift" added before subtracting products in
+# the tower Karatsuba combinations so redc inputs stay nonnegative (adding a
+# multiple of p never changes the residue).
+OFF_2PP = np.array(
+    [((2 * P * P) >> (LIMB_BITS * i)) & LIMB_MASK for i in range(2 * N_LIMBS)],
+    dtype=np.int32,
+)
+
+
+# -- field operations (Montgomery domain) -------------------------------------
+
+
+def add(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    s, _ = _carry_scan(a + b)  # a + b < 2p < 2^383: no carry out of limb 31
+    return _cond_sub(s)
+
+
+def sub(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    d, _ = _carry_scan(a - b + jnp.asarray(P_LIMBS))  # in (0, 2p); carry 0
+    return _cond_sub(d)
+
+
+def neg(a: jnp.ndarray) -> jnp.ndarray:
+    # p - a, with -0 = 0: subtract then map p back to 0 via cond_sub.
+    d, _ = _carry_scan(jnp.asarray(P_LIMBS) - a)
+    return _cond_sub(d)
+
+
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product: a * b * 2^-384 mod p, canonical output."""
+    return redc(poly(a, b), mult=2)
+
+
+def sqr(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, a)
+
+
+def _pow_bits(base: jnp.ndarray, bits: np.ndarray) -> jnp.ndarray:
+    """base^e for a fixed exponent given as MSB-first bits (left-to-right
+    square-and-multiply as a scan; batch-shape aware)."""
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), base.shape)
+
+    def step(acc, bit):
+        acc = sqr(acc)
+        return jnp.where(bit, mul(acc, base), acc), None
+
+    acc, _ = lax.scan(step, one, jnp.asarray(bits))
+    return acc
+
+
+def inv(a: jnp.ndarray) -> jnp.ndarray:
+    """a^-1 via Fermat (a^(p-2)); returns 0 for input 0 ("inv0" semantics,
+    which is exactly what the branch-free SSWU map needs, RFC 9380 §4)."""
+    return _pow_bits(a, _INV_EXP_BITS)
+
+
+def sqrt_candidate(a: jnp.ndarray) -> jnp.ndarray:
+    """a^((p+1)/4): the square root when a is a QR (p = 3 mod 4); callers
+    must check candidate^2 == a."""
+    return _pow_bits(a, _SQRT_EXP_BITS)
+
+
+def to_mont(a: jnp.ndarray) -> jnp.ndarray:
+    return mul(a, jnp.asarray(R2_LIMBS))
+
+
+def from_mont(a: jnp.ndarray) -> jnp.ndarray:
+    one = jnp.zeros_like(a).at[..., 0].set(1)
+    return mul(a, one)
+
+
+def is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=-1)
+
+
+def eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == b, axis=-1)
+
+
+def select(cond: jnp.ndarray, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Branch-free select: cond is (...,) bool; a, b are (..., 32)."""
+    return jnp.where(cond[..., None], a, b)
+
+
+def sgn0_mont(a: jnp.ndarray) -> jnp.ndarray:
+    """RFC 9380 sgn0 (parity of the canonical representative). Input is in
+    Montgomery form, so convert down first — this is off the hot path (used
+    once per SSWU evaluation)."""
+    return from_mont(a)[..., 0] & 1

