@@ -1,0 +1,66 @@
+"""Slot/epoch math, domains, and signing roots.
+
+Reference: /root/reference/consensus/types/src/{slot_epoch.rs,signing_data.rs,
+chain_spec.rs (compute_domain/get_domain equivalents)}.
+"""
+
+from __future__ import annotations
+
+from .containers import ForkData, SigningData
+from .spec import ChainSpec, Preset
+
+
+def compute_epoch_at_slot(slot: int, preset: Preset) -> int:
+    return slot // preset.slots_per_epoch
+
+
+def compute_start_slot_at_epoch(epoch: int, preset: Preset) -> int:
+    return epoch * preset.slots_per_epoch
+
+def compute_activation_exit_epoch(epoch: int, spec: ChainSpec) -> int:
+    return epoch + 1 + spec.max_seed_lookahead
+
+
+def compute_fork_data_root(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    fd = ForkData(
+        current_version=current_version,
+        genesis_validators_root=genesis_validators_root,
+    )
+    return ForkData.hash_tree_root(fd)
+
+
+def compute_fork_digest(current_version: bytes, genesis_validators_root: bytes) -> bytes:
+    return compute_fork_data_root(current_version, genesis_validators_root)[:4]
+
+
+def compute_domain(
+    domain_type: bytes,
+    fork_version: bytes | None = None,
+    genesis_validators_root: bytes = b"\x00" * 32,
+    spec: ChainSpec | None = None,
+) -> bytes:
+    """32-byte domain: 4-byte type || first 28 bytes of the fork data root."""
+    if fork_version is None:
+        fork_version = (spec or ChainSpec()).genesis_fork_version
+    fork_data_root = compute_fork_data_root(fork_version, genesis_validators_root)
+    return domain_type + fork_data_root[:28]
+
+
+def get_domain(state, domain_type: bytes, epoch: int | None, preset: Preset) -> bytes:
+    """Domain for signing at `epoch` given the state's fork schedule
+    (signature_sets.rs callers obtain domains this way)."""
+    if epoch is None:
+        epoch = compute_epoch_at_slot(state.slot, preset)
+    fork_version = (
+        state.fork.previous_version if epoch < state.fork.epoch else state.fork.current_version
+    )
+    return compute_domain(
+        domain_type, fork_version, state.genesis_validators_root
+    )
+
+
+def compute_signing_root(obj, domain: bytes) -> bytes:
+    """hash_tree_root(SigningData{object_root, domain}) — what actually gets
+    BLS-signed (/root/reference/consensus/types/src/signing_data.rs)."""
+    sd = SigningData(object_root=type(obj).hash_tree_root(obj), domain=domain)
+    return SigningData.hash_tree_root(sd)

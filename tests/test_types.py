@@ -1,0 +1,249 @@
+"""Consensus-type tests: round-trips, independently-computed tree roots,
+domains/signing roots.
+
+Tree-root known answers are computed *in the test* with plain hashlib
+(chunk layout per the SSZ spec), independent of lighthouse_tpu.ssz's
+merkleize — so a systematic bug in the production hasher cannot
+self-validate.
+"""
+
+import hashlib
+
+from lighthouse_tpu.types import (
+    AttestationData,
+    BeaconBlockHeader,
+    Checkpoint,
+    DepositData,
+    Eth1Data,
+    FAR_FUTURE_EPOCH,
+    Fork,
+    MAINNET_PRESET,
+    MINIMAL_PRESET,
+    MAINNET_SPEC,
+    SigningData,
+    Validator,
+    compute_domain,
+    compute_epoch_at_slot,
+    compute_signing_root,
+    compute_start_slot_at_epoch,
+    get_domain,
+    mainnet_types,
+    minimal_types,
+)
+
+
+def h(a: bytes, b: bytes) -> bytes:
+    return hashlib.sha256(a + b).digest()
+
+
+def u64_chunk(v: int) -> bytes:
+    return v.to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_checkpoint_root_known_answer():
+    cp = Checkpoint(epoch=5, root=b"\xaa" * 32)
+    expect = h(u64_chunk(5), b"\xaa" * 32)
+    assert Checkpoint.hash_tree_root(cp) == expect
+
+
+def test_fork_root_known_answer():
+    f = Fork(previous_version=b"\x01\x02\x03\x04", current_version=b"\x05\x06\x07\x08", epoch=9)
+    c0 = b"\x01\x02\x03\x04" + b"\x00" * 28
+    c1 = b"\x05\x06\x07\x08" + b"\x00" * 28
+    c2 = u64_chunk(9)
+    zero = b"\x00" * 32
+    expect = h(h(c0, c1), h(c2, zero))
+    assert Fork.hash_tree_root(f) == expect
+
+
+def test_attestation_data_root_known_answer():
+    src = Checkpoint(epoch=1, root=b"\x01" * 32)
+    tgt = Checkpoint(epoch=2, root=b"\x02" * 32)
+    ad = AttestationData(slot=3, index=4, beacon_block_root=b"\x03" * 32, source=src, target=tgt)
+    src_root = h(u64_chunk(1), b"\x01" * 32)
+    tgt_root = h(u64_chunk(2), b"\x02" * 32)
+    zero = b"\x00" * 32
+    # 5 leaves -> padded to 8
+    l = [u64_chunk(3), u64_chunk(4), b"\x03" * 32, src_root, tgt_root, zero, zero, zero]
+    expect = h(h(h(l[0], l[1]), h(l[2], l[3])), h(h(l[4], l[5]), h(l[6], l[7])))
+    assert AttestationData.hash_tree_root(ad) == expect
+
+
+def test_validator_root_known_answer():
+    v = Validator(
+        pubkey=b"\x11" * 48,
+        withdrawal_credentials=b"\x22" * 32,
+        effective_balance=32_000_000_000,
+        slashed=True,
+        activation_eligibility_epoch=0,
+        activation_epoch=1,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+    pk_root = h(b"\x11" * 32, b"\x11" * 16 + b"\x00" * 16)
+    leaves = [
+        pk_root,
+        b"\x22" * 32,
+        u64_chunk(32_000_000_000),
+        b"\x01" + b"\x00" * 31,
+        u64_chunk(0),
+        u64_chunk(1),
+        u64_chunk(FAR_FUTURE_EPOCH),
+        u64_chunk(FAR_FUTURE_EPOCH),
+    ]
+    expect = h(
+        h(h(leaves[0], leaves[1]), h(leaves[2], leaves[3])),
+        h(h(leaves[4], leaves[5]), h(leaves[6], leaves[7])),
+    )
+    assert Validator.hash_tree_root(v) == expect
+
+
+def _roundtrip(t, v):
+    data = t.serialize(v)
+    back = t.deserialize(data)
+    assert back == v
+    assert t.serialize(back) == data
+    return data
+
+
+def test_fixed_container_roundtrips():
+    _roundtrip(Checkpoint, Checkpoint(epoch=7, root=b"\x07" * 32))
+    _roundtrip(Eth1Data, Eth1Data(deposit_root=b"\x01" * 32, deposit_count=3, block_hash=b"\x02" * 32))
+    _roundtrip(
+        BeaconBlockHeader,
+        BeaconBlockHeader(
+            slot=1, proposer_index=2, parent_root=b"\x03" * 32, state_root=b"\x04" * 32, body_root=b"\x05" * 32
+        ),
+    )
+    _roundtrip(
+        DepositData,
+        DepositData(
+            pubkey=b"\x06" * 48, withdrawal_credentials=b"\x07" * 32, amount=9, signature=b"\x08" * 96
+        ),
+    )
+
+
+def test_attestation_roundtrip_minimal():
+    t = minimal_types()
+    att = t.Attestation(
+        aggregation_bits=[True, False, True],
+        data=AttestationData(
+            slot=1,
+            index=0,
+            beacon_block_root=b"\x09" * 32,
+            source=Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=Checkpoint(epoch=1, root=b"\x0a" * 32),
+        ),
+        signature=b"\x0b" * 96,
+    )
+    _roundtrip(t.Attestation, att)
+
+
+def test_indexed_attestation_roundtrip():
+    t = mainnet_types()
+    ia = t.IndexedAttestation(
+        attesting_indices=[1, 5, 9],
+        data=AttestationData.default(),
+        signature=b"\xcc" * 96,
+    )
+    _roundtrip(t.IndexedAttestation, ia)
+
+
+def test_block_roundtrip_with_operations():
+    t = minimal_types()
+    att = t.Attestation(
+        aggregation_bits=[True] * 4,
+        data=AttestationData.default(),
+        signature=b"\x01" * 96,
+    )
+    body = t.BeaconBlockBody(
+        randao_reveal=b"\x02" * 96,
+        eth1_data=Eth1Data.default(),
+        graffiti=b"graffiti".ljust(32, b"\x00"),
+        attestations=[att, att],
+    )
+    block = t.BeaconBlock(slot=3, proposer_index=1, parent_root=b"\x03" * 32, state_root=b"\x04" * 32, body=body)
+    sb = t.SignedBeaconBlock(message=block, signature=b"\x05" * 96)
+    _roundtrip(t.SignedBeaconBlock, sb)
+    # body_root consistency: header built from the block must commit to body
+    hdr = BeaconBlockHeader(
+        slot=3,
+        proposer_index=1,
+        parent_root=b"\x03" * 32,
+        state_root=b"\x04" * 32,
+        body_root=t.BeaconBlockBody.hash_tree_root(body),
+    )
+    assert hdr.body_root == t.BeaconBlockBody.hash_tree_root(body)
+
+
+def test_beacon_state_roundtrip_minimal():
+    t = minimal_types()
+    p = MINIMAL_PRESET
+    state = t.BeaconState(
+        genesis_time=12345,
+        genesis_validators_root=b"\x11" * 32,
+        slot=17,
+        fork=Fork(previous_version=b"\x00" * 4, current_version=b"\x00\x00\x00\x01", epoch=0),
+        validators=[
+            Validator(
+                pubkey=bytes([i]) * 48,
+                withdrawal_credentials=b"\x00" * 32,
+                effective_balance=32_000_000_000,
+                slashed=False,
+                activation_eligibility_epoch=0,
+                activation_epoch=0,
+                exit_epoch=FAR_FUTURE_EPOCH,
+                withdrawable_epoch=FAR_FUTURE_EPOCH,
+            )
+            for i in range(4)
+        ],
+        balances=[32_000_000_000] * 4,
+    )
+    data = _roundtrip(t.BeaconState, state)
+    # the state tree root must be sensitive to every mutated field
+    r0 = t.BeaconState.hash_tree_root(state)
+    state2 = t.BeaconState.deserialize(data)
+    state2.slot = 18
+    assert t.BeaconState.hash_tree_root(state2) != r0
+    # fixed-size vectors have preset lengths
+    assert len(state.block_roots) == p.slots_per_historical_root
+    assert len(state.randao_mixes) == p.epochs_per_historical_vector
+
+
+def test_preset_shapes_differ():
+    tm, tn = mainnet_types(), minimal_types()
+    sm = tm.BeaconState.default()
+    sn = tn.BeaconState.default()
+    assert len(sm.block_roots) == 8192 and len(sn.block_roots) == 64
+    # shared containers are the same class across presets
+    assert tm.Checkpoint is tn.Checkpoint
+
+
+def test_epoch_slot_math():
+    assert compute_epoch_at_slot(0, MAINNET_PRESET) == 0
+    assert compute_epoch_at_slot(31, MAINNET_PRESET) == 0
+    assert compute_epoch_at_slot(32, MAINNET_PRESET) == 1
+    assert compute_start_slot_at_epoch(2, MINIMAL_PRESET) == 16
+
+
+def test_domain_and_signing_root():
+    d = compute_domain(MAINNET_SPEC.domain_beacon_proposer, b"\x00" * 4, b"\x00" * 32)
+    assert len(d) == 32 and d[:4] == b"\x00\x00\x00\x00"
+    d2 = compute_domain(MAINNET_SPEC.domain_beacon_attester, b"\x00" * 4, b"\x00" * 32)
+    assert d2[:4] == b"\x01\x00\x00\x00" and d[4:] == d2[4:]
+    # signing root == hash_tree_root(SigningData)
+    cp = Checkpoint(epoch=1, root=b"\x01" * 32)
+    sr = compute_signing_root(cp, d)
+    sd = SigningData(object_root=Checkpoint.hash_tree_root(cp), domain=d)
+    assert sr == SigningData.hash_tree_root(sd)
+
+
+def test_get_domain_fork_schedule():
+    t = minimal_types()
+    state = t.BeaconState.default()
+    state.fork = Fork(previous_version=b"\x00\x00\x00\x00", current_version=b"\x01\x00\x00\x00", epoch=5)
+    state.slot = 5 * MINIMAL_PRESET.slots_per_epoch
+    pre = get_domain(state, b"\x00\x00\x00\x00", 4, MINIMAL_PRESET)
+    cur = get_domain(state, b"\x00\x00\x00\x00", 5, MINIMAL_PRESET)
+    assert pre != cur
+    assert cur == compute_domain(b"\x00\x00\x00\x00", b"\x01\x00\x00\x00", state.genesis_validators_root)
