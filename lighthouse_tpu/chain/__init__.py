@@ -1,0 +1,18 @@
+"""Chain core + in-process harness (SURVEY.md §7 Phase 3).
+
+Counterpart of /root/reference/beacon_node/beacon_chain: BeaconChain
+(block production/import/head), slot clocks, and the BeaconChainHarness
+used to drive an end-to-end chain without networking.
+"""
+
+from .beacon_chain import BeaconChain, BlockError
+from .harness import BeaconChainHarness
+from .slot_clock import ManualSlotClock, SystemSlotClock
+
+__all__ = [
+    "BeaconChain",
+    "BlockError",
+    "BeaconChainHarness",
+    "ManualSlotClock",
+    "SystemSlotClock",
+]

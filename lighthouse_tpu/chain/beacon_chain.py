@@ -1,0 +1,184 @@
+"""The chain core: block production, import, head tracking.
+
+The in-process heart of /root/reference/beacon_node/beacon_chain/src/
+beacon_chain.rs (process_block:2400, import_block:2462, produce_block:2889,
+fork_choice():3269), built around:
+  - state_transition with BlockSignatureStrategy.VERIFY_BULK — every block
+    signature (proposal, randao, slashings, attestations, exits) verifies as
+    ONE backend batch (on the jax backend, one device program)
+  - proto-array fork choice fed by block imports and attestations
+  - a Store for blocks and post-states
+
+No networking: this is SURVEY.md §7 Phase 3, the minimum end-to-end slice.
+"""
+
+from __future__ import annotations
+
+from ..fork_choice.fork_choice import ForkChoice
+from ..fork_choice.proto_array import ForkChoiceError
+from ..state_transition import (
+    BlockSignatureStrategy,
+    StateTransitionError,
+    TransitionContext,
+    per_block_processing,
+    process_slots,
+    state_transition,
+)
+from ..state_transition.helpers import (
+    get_beacon_proposer_index,
+    get_current_epoch,
+    get_indexed_attestation,
+)
+from ..state_transition import signature_sets as sigsets
+from ..store import MemoryStore
+from ..types import compute_epoch_at_slot, compute_signing_root, get_domain
+from ..types.containers import BeaconBlockHeader
+from .slot_clock import ManualSlotClock
+
+
+class BlockError(Exception):
+    pass
+
+
+class BeaconChain:
+    def __init__(self, genesis_state, ctx: TransitionContext, store=None, slot_clock=None):
+        self.ctx = ctx
+        self.store = store if store is not None else MemoryStore()
+        self.slot_clock = slot_clock if slot_clock is not None else ManualSlotClock()
+
+        t = ctx.types
+        genesis_state_root = t.BeaconState.hash_tree_root(genesis_state)
+        header = BeaconBlockHeader(
+            slot=genesis_state.slot,
+            proposer_index=genesis_state.latest_block_header.proposer_index,
+            parent_root=genesis_state.latest_block_header.parent_root,
+            state_root=genesis_state_root,
+            body_root=genesis_state.latest_block_header.body_root,
+        )
+        self.genesis_block_root = BeaconBlockHeader.hash_tree_root(header)
+        self.store.put_state(self.genesis_block_root, genesis_state)
+        self.fork_choice = ForkChoice(self.genesis_block_root, genesis_state, ctx)
+        self.head_root = self.genesis_block_root
+
+    # -- queries ---------------------------------------------------------------
+
+    def head_state(self):
+        return self.store.get_state(self.head_root)
+
+    def state_at_slot(self, slot: int):
+        """Head state advanced (with empty slots) to `slot` — a copy."""
+        state = self.head_state().copy()
+        if state.slot < slot:
+            process_slots(state, slot, self.ctx)
+        return state
+
+    # -- import (beacon_chain.rs:2400 process_block + 2462 import_block) -------
+
+    def process_block(
+        self,
+        signed_block,
+        strategy: BlockSignatureStrategy = BlockSignatureStrategy.VERIFY_BULK,
+    ) -> bytes:
+        t = self.ctx.types
+        block = signed_block.message
+        parent_root = bytes(block.parent_root)
+        parent_state = self.store.get_state(parent_root)
+        if parent_state is None:
+            raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
+
+        state = parent_state.copy()
+        try:
+            state_transition(state, signed_block, self.ctx, strategy=strategy)
+        except StateTransitionError as e:
+            raise BlockError(str(e)) from e
+
+        block_root = t.BeaconBlock.hash_tree_root(block)
+        self.store.put_block(block_root, signed_block)
+        self.store.put_state(block_root, state)
+
+        # fork choice: the block, then every attestation it carries
+        self.fork_choice.on_tick(max(self.slot(), block.slot))
+        self.fork_choice.on_block(block, block_root, state)
+        for att in block.body.attestations:
+            indexed = get_indexed_attestation(state, att, t, self.ctx.preset, self.ctx.spec)
+            try:
+                self.fork_choice.on_attestation(indexed, is_from_block=True)
+            except ForkChoiceError:
+                pass  # e.g. attestation for a block this store never saw
+        self.recompute_head()
+        return block_root
+
+    def apply_attestation(self, attestation) -> None:
+        """Unaggregated/gossip attestation -> fork choice (the tail of
+        beacon_chain.rs:1836 apply_attestation_to_fork_choice)."""
+        state = self.head_state()
+        indexed = get_indexed_attestation(
+            state, attestation, self.ctx.types, self.ctx.preset, self.ctx.spec
+        )
+        self.fork_choice.on_attestation(indexed)
+
+    def recompute_head(self) -> bytes:
+        self.head_root = self.fork_choice.get_head()
+        return self.head_root
+
+    def slot(self) -> int:
+        return self.slot_clock.now()
+
+    # -- production (beacon_chain.rs:2889 produce_block) -----------------------
+
+    def produce_block_on_state(
+        self,
+        state,
+        slot: int,
+        randao_reveal: bytes,
+        attestations=(),
+        deposits=(),
+        exits=(),
+        proposer_slashings=(),
+        attester_slashings=(),
+        graffiti: bytes = b"\x00" * 32,
+    ):
+        """Build an (unsigned) block on `state` advanced to `slot`; returns
+        (block, post_state). The caller signs it."""
+        t = self.ctx.types
+        if state.slot < slot:
+            process_slots(state, slot, self.ctx)
+        parent_root = BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+        proposer_index = get_beacon_proposer_index(state, self.ctx.preset, self.ctx.spec)
+        body = t.BeaconBlockBody(
+            randao_reveal=randao_reveal,
+            eth1_data=state.eth1_data,
+            graffiti=graffiti,
+            proposer_slashings=list(proposer_slashings),
+            attester_slashings=list(attester_slashings),
+            attestations=list(attestations),
+            deposits=list(deposits),
+            voluntary_exits=list(exits),
+        )
+        block = t.BeaconBlock(
+            slot=slot,
+            proposer_index=proposer_index,
+            parent_root=parent_root,
+            state_root=b"\x00" * 32,
+            body=body,
+        )
+        signed = t.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+        per_block_processing(
+            state, signed, self.ctx, strategy=BlockSignatureStrategy.NO_VERIFICATION
+        )
+        block.state_root = t.BeaconState.hash_tree_root(state)
+        return block, state
+
+    def sign_block(self, block, secret_key):
+        """Proposal signature (signature_sets.rs:55 semantics)."""
+        state = self.store.get_state(bytes(block.parent_root)) or self.head_state()
+        domain = get_domain(
+            state,
+            self.ctx.spec.domain_beacon_proposer,
+            compute_epoch_at_slot(block.slot, self.ctx.preset),
+            self.ctx.preset,
+        )
+        root = compute_signing_root(block, domain)
+        return self.ctx.types.SignedBeaconBlock(
+            message=block, signature=secret_key.sign(root).to_bytes()
+        )

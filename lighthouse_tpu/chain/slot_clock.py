@@ -1,0 +1,36 @@
+"""Slot clocks.
+
+Counterpart of /root/reference/common/slot_clock: SystemSlotClock maps wall
+time to slots; ManualSlotClock is the test/harness clock advanced by hand
+(manual_slot_clock.rs — the clock BeaconChainHarness uses).
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class ManualSlotClock:
+    def __init__(self, genesis_slot: int = 0):
+        self._slot = genesis_slot
+
+    def now(self) -> int:
+        return self._slot
+
+    def set_slot(self, slot: int) -> None:
+        self._slot = slot
+
+    def advance(self, n: int = 1) -> None:
+        self._slot += n
+
+
+class SystemSlotClock:
+    def __init__(self, genesis_time: int, seconds_per_slot: int):
+        self.genesis_time = genesis_time
+        self.seconds_per_slot = seconds_per_slot
+
+    def now(self) -> int:
+        t = time.time()
+        if t < self.genesis_time:
+            return 0
+        return int(t - self.genesis_time) // self.seconds_per_slot

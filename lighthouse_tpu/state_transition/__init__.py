@@ -1,0 +1,45 @@
+"""State transition (phase0): per-slot/block/epoch processing, signature
+sets, and the bulk block-signature verifier.
+
+Counterpart of /root/reference/consensus/state_processing (SURVEY.md §2.2):
+the layer that turns consensus objects into the device-sized signature
+batches the TPU verifier consumes.
+"""
+
+from .context import PubkeyCache, TransitionContext
+from .helpers import StateTransitionError
+from .per_block import (
+    BlockSignatureStrategy,
+    BlockSignatureVerifier,
+    per_block_processing,
+    process_attestation,
+    process_block_header,
+    process_deposit,
+    process_eth1_data,
+    process_operations,
+    process_randao,
+)
+from .per_epoch import process_epoch
+from .per_slot import per_slot_processing, process_slot, process_slots, state_transition
+from .genesis import interop_genesis_state
+
+__all__ = [
+    "PubkeyCache",
+    "TransitionContext",
+    "StateTransitionError",
+    "BlockSignatureStrategy",
+    "BlockSignatureVerifier",
+    "per_block_processing",
+    "process_attestation",
+    "process_block_header",
+    "process_deposit",
+    "process_eth1_data",
+    "process_operations",
+    "process_randao",
+    "process_epoch",
+    "per_slot_processing",
+    "process_slot",
+    "process_slots",
+    "state_transition",
+    "interop_genesis_state",
+]

@@ -1,0 +1,70 @@
+"""Transition context: the bundle every processing function needs.
+
+Groups {container types, chain spec, bls backend, pubkey resolver} — the
+runtime equivalent of the reference's generic parameters
+(`per_block_processing<T: EthSpec>` + the &ChainSpec argument +
+the compile-time-selected bls backend).
+
+The default pubkey resolver decompresses validator pubkeys from the state
+on first use and memoizes by (index, pubkey-bytes) — the in-process role of
+the reference's ValidatorPubkeyCache
+(/root/reference/beacon_node/beacon_chain/src/validator_pubkey_cache.rs:12-37).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..crypto import bls as bls_pkg
+from ..types import ChainSpec, MAINNET_SPEC, MINIMAL_SPEC, Preset
+from ..types.containers import SpecTypes, mainnet_types, minimal_types
+
+
+class PubkeyCache:
+    """index -> decompressed backend PublicKey, memoized."""
+
+    def __init__(self, bls_mod):
+        self.bls = bls_mod
+        self._cache: dict[tuple[int, bytes], Any] = {}
+
+    def resolver(self, state) -> Callable[[int], Any]:
+        def resolve(index: int):
+            if not 0 <= index < len(state.validators):
+                return None
+            raw = bytes(state.validators[index].pubkey)
+            key = (index, raw)
+            pk = self._cache.get(key)
+            if pk is None:
+                try:
+                    pk = self.bls.PublicKey.from_bytes(raw)
+                except self.bls.DecodeError:
+                    return None
+                self._cache[key] = pk
+            return pk
+
+        return resolve
+
+
+@dataclass
+class TransitionContext:
+    types: SpecTypes
+    spec: ChainSpec
+    bls: Any
+    pubkeys: PubkeyCache = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.pubkeys is None:
+            self.pubkeys = PubkeyCache(self.bls)
+
+    @property
+    def preset(self) -> Preset:
+        return self.types.preset
+
+    @staticmethod
+    def minimal(bls_name: str = "ref") -> "TransitionContext":
+        return TransitionContext(minimal_types(), MINIMAL_SPEC, bls_pkg.backend(bls_name))
+
+    @staticmethod
+    def mainnet(bls_name: str = "ref") -> "TransitionContext":
+        return TransitionContext(mainnet_types(), MAINNET_SPEC, bls_pkg.backend(bls_name))

@@ -1,0 +1,81 @@
+"""Interop genesis state — deterministic keypairs, no deposit proofs.
+
+Mirrors /root/reference/beacon_node/genesis/src/interop.rs
+(interop_genesis_state): validators are created directly from the interop
+secret keys with BLS withdrawal credentials, all fully active at genesis.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from ..types import GENESIS_EPOCH, ChainSpec, Preset
+from ..types.containers import (
+    BeaconBlockHeader,
+    Eth1Data,
+    Fork,
+    Validator,
+)
+from .context import TransitionContext
+
+BLS_WITHDRAWAL_PREFIX = b"\x00"
+
+
+def interop_validator(pubkey_bytes: bytes, spec: ChainSpec) -> Validator:
+    wc = BLS_WITHDRAWAL_PREFIX + hashlib.sha256(pubkey_bytes).digest()[1:]
+    return Validator(
+        pubkey=pubkey_bytes,
+        withdrawal_credentials=wc,
+        effective_balance=spec.max_effective_balance,
+        slashed=False,
+        activation_eligibility_epoch=GENESIS_EPOCH,
+        activation_epoch=GENESIS_EPOCH,
+        exit_epoch=2**64 - 1,
+        withdrawable_epoch=2**64 - 1,
+    )
+
+
+def interop_genesis_state(n_validators: int, genesis_time: int, ctx: TransitionContext):
+    """Build a fully-active genesis BeaconState for n interop validators."""
+    t, preset, spec = ctx.types, ctx.preset, ctx.spec
+    eth1_block_hash = b"\x42" * 32
+
+    validators = []
+    for i in range(n_validators):
+        _, pk = ctx.bls.interop_keypair(i)
+        validators.append(interop_validator(pk.to_bytes(), spec))
+
+    state = t.BeaconState(
+        genesis_time=genesis_time,
+        slot=0,
+        fork=Fork(
+            previous_version=spec.genesis_fork_version,
+            current_version=spec.genesis_fork_version,
+            epoch=GENESIS_EPOCH,
+        ),
+        latest_block_header=BeaconBlockHeader(
+            slot=0,
+            proposer_index=0,
+            parent_root=b"\x00" * 32,
+            state_root=b"\x00" * 32,
+            body_root=t.BeaconBlockBody.hash_tree_root(t.BeaconBlockBody.default()),
+        ),
+        eth1_data=Eth1Data(
+            deposit_root=b"\x00" * 32,
+            deposit_count=n_validators,
+            block_hash=eth1_block_hash,
+        ),
+        eth1_deposit_index=n_validators,
+        validators=validators,
+        balances=[spec.max_effective_balance] * n_validators,
+        randao_mixes=[eth1_block_hash] * preset.epochs_per_historical_vector,
+    )
+    from ..ssz.types import List, Bytes48 as _B48  # noqa: F401
+
+    # genesis_validators_root commits to the registry (spec
+    # initialize_beacon_state_from_eth1 tail).
+    validators_field = dict(zip(t.BeaconState._field_names, t.BeaconState._field_types))[
+        "validators"
+    ]
+    state.genesis_validators_root = validators_field.hash_tree_root(state.validators)
+    return state

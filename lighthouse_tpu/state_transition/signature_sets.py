@@ -1,0 +1,186 @@
+"""SignatureSet constructors: consensus objects -> verifiable {signature,
+pubkeys, message} triples.
+
+Python rendering of the constructor fns in
+/root/reference/consensus/state_processing/src/per_block_processing/
+signature_sets.rs:55-562. Every constructor takes `bls` (a backend module
+from lighthouse_tpu.crypto.bls — ref/fake/jax) and `pubkey`, a
+validator-index -> decompressed-PublicKey resolver (the ValidatorPubkeyCache
+role, /root/reference/beacon_node/beacon_chain/src/validator_pubkey_cache.rs).
+
+Constructors raise StateTransitionError for structurally-invalid inputs
+(unknown validator, undecodable signature) — mirroring the reference's
+Error::ValidatorUnknown / BadSignature split from verification failure.
+
+The signed *message* in every set is a 32-byte signing root
+(compute_signing_root = hash_tree_root(SigningData{object_root, domain})),
+so sets from heterogeneous operations batch uniformly on the device.
+"""
+
+from __future__ import annotations
+
+from ..ssz.types import uint64
+from ..types import (
+    ChainSpec,
+    Preset,
+    compute_signing_root,
+    get_domain,
+)
+from ..types.containers import SigningData
+from .helpers import StateTransitionError
+
+
+def _signing_root_for_uint64(value: int, domain: bytes) -> bytes:
+    sd = SigningData(object_root=uint64.hash_tree_root(value), domain=domain)
+    return SigningData.hash_tree_root(sd)
+
+
+def _decode_signature(bls, sig_bytes: bytes):
+    try:
+        return bls.Signature.from_bytes(bytes(sig_bytes))
+    except bls.DecodeError as e:
+        raise StateTransitionError(f"undecodable signature: {e}") from e
+
+
+def _resolve(pubkey, index: int):
+    pk = pubkey(index)
+    if pk is None:
+        raise StateTransitionError(f"unknown validator index {index}")
+    return pk
+
+
+def block_proposal_signature_set(
+    state, signed_block, proposer_index: int, bls, pubkey, preset: Preset, spec: ChainSpec
+):
+    """signature_sets.rs:55 block_proposal_signature_set."""
+    block = signed_block.message
+    if block.proposer_index != proposer_index:
+        raise StateTransitionError("incorrect proposer index")
+    domain = get_domain(
+        state, spec.domain_beacon_proposer, compute_epoch(block.slot, preset), preset
+    )
+    root = compute_signing_root(block, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, signed_block.signature),
+        signing_keys=[_resolve(pubkey, proposer_index)],
+        message=root,
+    )
+
+
+def compute_epoch(slot: int, preset: Preset) -> int:
+    return slot // preset.slots_per_epoch
+
+
+def randao_signature_set(state, randao_reveal, proposer_index: int, bls, pubkey, preset: Preset, spec: ChainSpec):
+    """signature_sets.rs randao_signature_set: message is the epoch (as SSZ
+    uint64) under DOMAIN_RANDAO."""
+    epoch = compute_epoch(state.slot, preset)
+    domain = get_domain(state, spec.domain_randao, epoch, preset)
+    root = _signing_root_for_uint64(epoch, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, randao_reveal),
+        signing_keys=[_resolve(pubkey, proposer_index)],
+        message=root,
+    )
+
+
+def block_header_signature_set(state, signed_header, bls, pubkey, preset: Preset, spec: ChainSpec):
+    """One half of a proposer slashing (signature_sets.rs
+    proposer_slashing_signature_set builds two of these)."""
+    header = signed_header.message
+    domain = get_domain(state, spec.domain_beacon_proposer, compute_epoch(header.slot, preset), preset)
+    root = compute_signing_root(header, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, signed_header.signature),
+        signing_keys=[_resolve(pubkey, header.proposer_index)],
+        message=root,
+    )
+
+
+def proposer_slashing_signature_sets(state, slashing, bls, pubkey, preset: Preset, spec: ChainSpec):
+    return (
+        block_header_signature_set(state, slashing.signed_header_1, bls, pubkey, preset, spec),
+        block_header_signature_set(state, slashing.signed_header_2, bls, pubkey, preset, spec),
+    )
+
+
+def indexed_attestation_signature_set(state, indexed, bls, pubkey, preset: Preset, spec: ChainSpec):
+    """signature_sets.rs indexed_attestation_signature_set: one set with ALL
+    attesting pubkeys (aggregate verify of the same message)."""
+    domain = get_domain(state, spec.domain_beacon_attester, indexed.data.target.epoch, preset)
+    root = compute_signing_root(indexed.data, domain)
+    keys = [_resolve(pubkey, i) for i in indexed.attesting_indices]
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, indexed.signature),
+        signing_keys=keys,
+        message=root,
+    )
+
+
+def attester_slashing_signature_sets(state, slashing, bls, pubkey, preset: Preset, spec: ChainSpec):
+    return (
+        indexed_attestation_signature_set(state, slashing.attestation_1, bls, pubkey, preset, spec),
+        indexed_attestation_signature_set(state, slashing.attestation_2, bls, pubkey, preset, spec),
+    )
+
+
+def deposit_signature_set(deposit_data, bls, spec: ChainSpec):
+    """signature_sets.rs deposit_pubkey_signature_message: deposits are
+    signed over DepositMessage with the *genesis* fork domain (they must
+    validate across forks), and the pubkey comes from the deposit itself."""
+    from ..types import compute_domain
+    from ..types.containers import DepositMessage
+
+    msg = DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    domain = compute_domain(spec.domain_deposit, spec.genesis_fork_version, b"\x00" * 32)
+    root = compute_signing_root(msg, domain)
+    try:
+        pk = bls.PublicKey.from_bytes(bytes(deposit_data.pubkey))
+    except bls.DecodeError as e:
+        raise StateTransitionError(f"undecodable deposit pubkey: {e}") from e
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, deposit_data.signature),
+        signing_keys=[pk],
+        message=root,
+    )
+
+
+def exit_signature_set(state, signed_exit, bls, pubkey, preset: Preset, spec: ChainSpec):
+    exit_msg = signed_exit.message
+    domain = get_domain(state, spec.domain_voluntary_exit, exit_msg.epoch, preset)
+    root = compute_signing_root(exit_msg, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, signed_exit.signature),
+        signing_keys=[_resolve(pubkey, exit_msg.validator_index)],
+        message=root,
+    )
+
+
+def selection_proof_signature_set(state, slot: int, aggregator_index: int, selection_proof, bls, pubkey, preset: Preset, spec: ChainSpec):
+    """signature_sets.rs signed_aggregate_selection_proof_signature_set:
+    message is the slot (SSZ uint64) under DOMAIN_SELECTION_PROOF."""
+    domain = get_domain(state, spec.domain_selection_proof, compute_epoch(slot, preset), preset)
+    root = _signing_root_for_uint64(slot, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, selection_proof),
+        signing_keys=[_resolve(pubkey, aggregator_index)],
+        message=root,
+    )
+
+
+def aggregate_and_proof_signature_set(state, signed_aggregate, bls, pubkey, preset: Preset, spec: ChainSpec):
+    """signature_sets.rs signed_aggregate_signature_set."""
+    msg = signed_aggregate.message
+    domain = get_domain(
+        state, spec.domain_aggregate_and_proof, compute_epoch(msg.aggregate.data.slot, preset), preset
+    )
+    root = compute_signing_root(msg, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, signed_aggregate.signature),
+        signing_keys=[_resolve(pubkey, msg.aggregator_index)],
+        message=root,
+    )

@@ -1,0 +1,10 @@
+"""Block/state storage.
+
+Counterpart of /root/reference/beacon_node/store (SURVEY.md §2.3): the
+MemoryStore here plays the role of memory_store.rs for the in-process
+harness; a hot/cold split can slot in behind the same Store interface.
+"""
+
+from .memory import MemoryStore, Store
+
+__all__ = ["MemoryStore", "Store"]

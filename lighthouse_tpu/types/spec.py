@@ -186,7 +186,11 @@ MINIMAL_SPEC = ChainSpec(
     genesis_fork_version=b"\x00\x00\x00\x01",
     seconds_per_slot=6,
     min_genesis_active_validator_count=64,
+    min_genesis_time=1578009600,
     min_validator_withdrawability_delay=256,
     shard_committee_period=64,
     genesis_delay=300,
+    churn_limit_quotient=32,
+    deposit_chain_id=5,
+    deposit_network_id=5,
 )

@@ -1,0 +1,113 @@
+"""Proto-array fork choice scenario tests.
+
+Scenario style mirrors the reference's fork-choice test DSL
+(/root/reference/consensus/proto_array/src/fork_choice_test_definition/):
+sequences of block insertions, votes, and find_head assertions over a known
+small tree. Data here is original; semantics are the reference's.
+"""
+
+import pytest
+
+from lighthouse_tpu.fork_choice.proto_array import (
+    ForkChoiceError,
+    ProtoArray,
+    VoteTracker,
+    compute_deltas,
+)
+
+
+def r(n: int) -> bytes:
+    return bytes([n]) * 32
+
+
+def build_array(edges, justified_epoch=1, finalized_epoch=1):
+    """edges: list of (slot, root, parent_root_or_None)."""
+    p = ProtoArray()
+    p.justified_epoch = justified_epoch
+    p.finalized_epoch = finalized_epoch
+    for slot, root, parent in edges:
+        p.on_block(slot, root, parent, justified_epoch, finalized_epoch)
+    return p
+
+
+def test_single_chain_head_is_tip():
+    p = build_array([(0, r(0), None), (1, r(1), r(0)), (2, r(2), r(1))])
+    p.apply_score_changes([0, 0, 0], 1, 1)
+    assert p.find_head(r(0)) == r(2)
+    assert p.find_head(r(1)) == r(2)
+
+
+def test_fork_tiebreak_by_root():
+    # two children of genesis with equal (zero) weight: higher root wins
+    p = build_array([(0, r(0), None), (1, r(1), r(0)), (1, r(2), r(0))])
+    p.apply_score_changes([0, 0, 0], 1, 1)
+    assert p.find_head(r(0)) == r(2)
+
+
+def test_fork_votes_move_head():
+    p = build_array([(0, r(0), None), (1, r(1), r(0)), (1, r(2), r(0))])
+    # two voters on root 1, one on root 2
+    votes = [VoteTracker(), VoteTracker(), VoteTracker()]
+    votes[0].next_root, votes[0].next_epoch = r(1), 1
+    votes[1].next_root, votes[1].next_epoch = r(1), 1
+    votes[2].next_root, votes[2].next_epoch = r(2), 1
+    balances = [10, 10, 10]
+    deltas = compute_deltas(p.indices, votes, [0, 0, 0], balances)
+    p.apply_score_changes(deltas, 1, 1)
+    assert p.find_head(r(0)) == r(1)
+    # voters migrate to root 2: head follows
+    for v in votes:
+        v.next_root, v.next_epoch = r(2), 2
+    deltas = compute_deltas(p.indices, votes, balances, balances)
+    p.apply_score_changes(deltas, 1, 1)
+    assert p.find_head(r(0)) == r(2)
+
+
+def test_deltas_move_weight_not_duplicate():
+    p = build_array([(0, r(0), None), (1, r(1), r(0)), (1, r(2), r(0))])
+    votes = [VoteTracker()]
+    votes[0].next_root, votes[0].next_epoch = r(1), 1
+    deltas = compute_deltas(p.indices, votes, [0], [7])
+    p.apply_score_changes(deltas, 1, 1)
+    assert p.nodes[p.indices[r(1)]].weight == 7
+    votes[0].next_root, votes[0].next_epoch = r(2), 2
+    deltas = compute_deltas(p.indices, votes, [7], [7])
+    p.apply_score_changes(deltas, 1, 1)
+    assert p.nodes[p.indices[r(1)]].weight == 0
+    assert p.nodes[p.indices[r(2)]].weight == 7
+
+
+def test_justification_filters_branch():
+    # branch with mismatched justified epoch is not viable for head
+    p = ProtoArray()
+    p.on_block(0, r(0), None, 1, 1)
+    p.on_block(1, r(1), r(0), 1, 1)  # viable branch
+    p.on_block(1, r(2), r(0), 0, 0)  # stale-justification branch
+    votes = [VoteTracker()]
+    votes[0].next_root, votes[0].next_epoch = r(2), 1
+    deltas = compute_deltas(p.indices, votes, [0], [100])
+    p.apply_score_changes(deltas, 1, 1)
+    # despite all weight on r(2), head must be r(1): r(2) disagrees with the
+    # store's justified/finalized epochs
+    assert p.find_head(r(0)) == r(1)
+
+
+def test_prune_keeps_descendants():
+    p = build_array([(i, r(i), r(i - 1) if i else None) for i in range(5)])
+    p.prune_threshold = 0
+    p.apply_score_changes([0] * 5, 1, 1)
+    p.maybe_prune(r(2))
+    assert r(0) not in p.indices and r(1) not in p.indices
+    assert p.find_head(r(2)) == r(4)
+
+
+def test_unknown_justified_root_raises():
+    p = build_array([(0, r(0), None)])
+    with pytest.raises(ForkChoiceError):
+        p.find_head(r(9))
+
+
+def test_wrong_deltas_length_raises():
+    p = build_array([(0, r(0), None)])
+    with pytest.raises(ForkChoiceError):
+        p.apply_score_changes([0, 0], 1, 1)

@@ -165,7 +165,9 @@ def test_block_roundtrip_with_operations():
     block = t.BeaconBlock(slot=3, proposer_index=1, parent_root=b"\x03" * 32, state_root=b"\x04" * 32, body=body)
     sb = t.SignedBeaconBlock(message=block, signature=b"\x05" * 96)
     _roundtrip(t.SignedBeaconBlock, sb)
-    # body_root consistency: header built from the block must commit to body
+    # SSZ identity the whole chain relies on: a BeaconBlockHeader whose
+    # body_root commits to the body has the SAME tree root as the full block
+    # (this is why parent_root can be checked against latest_block_header).
     hdr = BeaconBlockHeader(
         slot=3,
         proposer_index=1,
@@ -173,7 +175,7 @@ def test_block_roundtrip_with_operations():
         state_root=b"\x04" * 32,
         body_root=t.BeaconBlockBody.hash_tree_root(body),
     )
-    assert hdr.body_root == t.BeaconBlockBody.hash_tree_root(body)
+    assert BeaconBlockHeader.hash_tree_root(hdr) == t.BeaconBlock.hash_tree_root(block)
 
 
 def test_beacon_state_roundtrip_minimal():
