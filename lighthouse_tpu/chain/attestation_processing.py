@@ -1,0 +1,74 @@
+"""Batched gossip-attestation verification with poisoning fallback.
+
+Python rendering of /root/reference/beacon_node/beacon_chain/src/
+attestation_verification/batch.rs:139-222 (batch_verify_unaggregated_
+attestations): per-attestation structural checks first, then ONE backend
+batch over every surviving signature set; if the batch rejects, fall back
+to per-set verification so a single bad signature cannot poison the rest
+(batch.rs:203-219). On the jax backend the batch call is one device
+program — this is the gossip hot path the BeaconProcessor's re-batching
+exists to feed (SURVEY.md §2.8 items 1 & 3).
+"""
+
+from __future__ import annotations
+
+from ..state_transition import signature_sets as sigsets
+from ..state_transition.helpers import (
+    StateTransitionError,
+    get_indexed_attestation,
+)
+from ..fork_choice.proto_array import ForkChoiceError
+
+
+class AttestationError(Exception):
+    pass
+
+
+def batch_verify_gossip_attestations(chain, attestations, apply_to_fork_choice: bool = True):
+    """Verify a batch of unaggregated/aggregated gossip attestations.
+
+    Returns a list aligned with `attestations`: True for accepted, or an
+    Exception describing the rejection. Accepted attestations are applied
+    to fork choice when `apply_to_fork_choice`."""
+    ctx = chain.ctx
+    state = chain.head_state()
+    pubkey = ctx.pubkeys.resolver(state)
+
+    results: list = [None] * len(attestations)
+    staged = []  # (index, indexed_attestation, signature_set)
+    for i, att in enumerate(attestations):
+        try:
+            if not chain.fork_choice.contains_block(bytes(att.data.beacon_block_root)):
+                raise AttestationError("unknown head block")
+            indexed = get_indexed_attestation(state, att, ctx.types, ctx.preset, ctx.spec)
+            if not indexed.attesting_indices:
+                raise AttestationError("empty attestation")
+            s = sigsets.indexed_attestation_signature_set(
+                state, indexed, ctx.bls, pubkey, ctx.preset, ctx.spec
+            )
+            staged.append((i, indexed, s))
+        except (AttestationError, StateTransitionError) as e:
+            results[i] = e
+
+    if staged:
+        sets = [s for _, _, s in staged]
+        if ctx.bls.verify_signature_sets(sets):
+            for i, _, _ in staged:
+                results[i] = True
+        else:
+            # poisoning fallback: re-verify individually (batch.rs:203-219)
+            for i, _, s in staged:
+                results[i] = (
+                    True
+                    if ctx.bls.verify_signature_sets([s])
+                    else AttestationError("invalid signature")
+                )
+
+    if apply_to_fork_choice:
+        for i, indexed, _ in staged:
+            if results[i] is True:
+                try:
+                    chain.fork_choice.on_attestation(indexed)
+                except ForkChoiceError:
+                    pass
+    return results

@@ -1,0 +1,5 @@
+"""Common utilities (SURVEY.md §2.5): metrics, logging glue."""
+
+from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
+
+__all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "Registry"]

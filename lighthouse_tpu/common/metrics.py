@@ -1,0 +1,172 @@
+"""Prometheus-style metrics registry.
+
+Counterpart of /root/reference/common/lighthouse_metrics (src/lib.rs:1-18):
+a process-global registry of counters/gauges/histograms with timer helpers
+wrapping pipeline stages, and text exposition in the Prometheus format
+(served by http_metrics). No external dependency — exposition is a string.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Metric:
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._lock = threading.Lock()
+
+
+class Counter(Metric):
+    def __init__(self, name: str, help_text: str):
+        super().__init__(name, help_text)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} counter\n"
+            f"{self.name} {self._value}\n"
+        )
+
+
+class Gauge(Metric):
+    def __init__(self, name: str, help_text: str):
+        super().__init__(name, help_text)
+        self._value = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = v
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        self.inc(-n)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def expose(self) -> str:
+        return (
+            f"# HELP {self.name} {self.help}\n"
+            f"# TYPE {self.name} gauge\n"
+            f"{self.name} {self._value}\n"
+        )
+
+
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(Metric):
+    def __init__(self, name: str, help_text: str, buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_text)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, v: float) -> None:
+        with self._lock:
+            self._sum += v
+            self._n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    @contextmanager
+    def time(self):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.observe(time.perf_counter() - t0)
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def expose(self) -> str:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        cumulative = 0
+        for b, c in zip(self.buckets, self._counts):
+            cumulative += c
+            lines.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
+        cumulative += self._counts[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
+        lines.append(f"{self.name}_sum {self._sum}")
+        lines.append(f"{self.name}_count {self._n}")
+        return "\n".join(lines) + "\n"
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, cls, name: str, help_text: str, **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls):
+                    raise ValueError(f"metric {name} already registered with another type")
+                return existing
+            m = cls(name, help_text, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_text: str = "") -> Counter:
+        return self._register(Counter, name, help_text)
+
+    def gauge(self, name: str, help_text: str = "") -> Gauge:
+        return self._register(Gauge, name, help_text)
+
+    def histogram(self, name: str, help_text: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
+        return self._register(Histogram, name, help_text, buckets=buckets)
+
+    def gather(self) -> str:
+        """Prometheus text exposition of every registered metric."""
+        with self._lock:
+            return "".join(m.expose() for _, m in sorted(self._metrics.items()))
+
+
+# The process-global registry (lighthouse_metrics' lazy_static pattern).
+REGISTRY = Registry()
+
+# Core framework metrics (the reference instruments the same stages:
+# attestation_verification/batch.rs:60-61, beacon_chain/src/metrics.rs).
+BLS_BATCH_SECONDS = REGISTRY.histogram(
+    "lighthouse_tpu_bls_batch_verify_seconds", "Device batch verification wall time"
+)
+BLS_SETS_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_bls_signature_sets_total", "Signature sets verified"
+)
+BLOCK_IMPORT_SECONDS = REGISTRY.histogram(
+    "lighthouse_tpu_block_import_seconds", "Full block import wall time"
+)
+PROCESSOR_QUEUE_DEPTH = REGISTRY.gauge(
+    "lighthouse_tpu_processor_queue_depth", "BeaconProcessor total queued events"
+)

@@ -1,0 +1,14 @@
+"""Spec-conformance runners.
+
+Counterpart of /root/reference/testing/ef_tests (handler.rs:10): typed test
+cases executed identically against every BLS backend — the reference's
+3-backend CI matrix (/root/reference/Makefile:98-103). Official
+consensus-spec-tests archives are unavailable offline, so the BLS vectors
+are generated locally against the pure-Python oracle plus hand-built edge
+cases (infinity pubkeys, invalid encodings, non-subgroup points) covering
+the same behaviors the official bls runner checks.
+"""
+
+from .bls_cases import ALL_CASE_TYPES, BlsCase, generate_bls_cases, run_case
+
+__all__ = ["ALL_CASE_TYPES", "BlsCase", "generate_bls_cases", "run_case"]

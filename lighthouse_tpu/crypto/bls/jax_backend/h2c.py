@@ -91,16 +91,10 @@ def _minus_one():
 
 
 def _fp2_pow_bits(base, bits: np.ndarray):
-    """base^e for a fixed public exponent (MSB-first bit table), in Fp2."""
-    one = fp2_one(base.shape[:-2])
-
-    def step(acc, bit):
-        acc = fp2_sqr(acc)
-        take = jnp.broadcast_to(bit != 0, acc.shape[:-2])
-        return fp2_select(take, fp2_mul(acc, base), acc), None
-
-    acc, _ = lax.scan(step, one, jnp.asarray(bits))
-    return acc
+    """base^e for a fixed public exponent (MSB-first bit table), in Fp2 —
+    2^4-ary windowed (see fp._pow_bits_windowed: scan-depth, not FLOPs, is
+    what this kernel pays for)."""
+    return fp._pow_bits_windowed(base, bits, fp2_mul, fp2_sqr, fp2_one(base.shape[:-2]))
 
 
 def fp2_sqrt_candidate(x):
@@ -132,11 +126,14 @@ def sswu(u):
     )
     x1 = fp2_select(t1_zero, jnp.broadcast_to(jnp.asarray(_C2), x1_generic.shape), x1_generic)
     gx1 = fp2_add(fp2_add(fp2_mul(fp2_sqr(x1), x1), fp2_mul(A, x1)), B)
-    y1 = fp2_sqrt_candidate(gx1)
-    is_sq = fp2_eq(fp2_sqr(y1), gx1)
     x2 = fp2_mul(zu2, x1)
     gx2 = fp2_add(fp2_add(fp2_mul(fp2_sqr(x2), x2), fp2_mul(A, x2)), B)
-    y2 = fp2_sqrt_candidate(gx2)
+    # The two square-root candidates are independent: stack them so the two
+    # ~380-bit exponent scans (the single most sequential part of hash-to-G2)
+    # run as ONE scan at doubled batch width.
+    cand = fp2_sqrt_candidate(jnp.stack([gx1, gx2]))
+    y1, y2 = cand[0], cand[1]
+    is_sq = fp2_eq(fp2_sqr(y1), gx1)
     x = fp2_select(is_sq, x1, x2)
     y = fp2_select(is_sq, y1, y2)
     flip = fp2_sgn0(u) != fp2_sgn0(y)

@@ -179,16 +179,24 @@ def miller_loop(px, py, p_inf, qx, qy, q_inf):
     t0 = Jac(qx, qy, FP2.one(shape))
     f0 = fp12_one(shape)
 
+    # |z| = 0xd201000000010000 has Hamming weight 6: the addition step is
+    # needed on only 5 of the 63 iterations. The doubling runs every step;
+    # the addition sits behind a lax.cond on the (scalar, per-step) bit, so
+    # it executes on 5 iterations only — runtime sparsity at the cost of one
+    # compiled scan body (a fully unrolled form compiles ~5x slower for the
+    # same runtime).
     def step(carry, bit):
         t, f = carry
         f = fp12_sqr(f)
         t, (a0, a3, a5) = _dbl_step(t, px, py)
         f = _mul_by_line(f, a0, a3, a5)
-        t_add, (b0, b3, b5) = _add_step(t, qx, qy, px, py)
-        f_add = _mul_by_line(f, b0, b3, b5)
-        take = jnp.broadcast_to(bit != 0, shape)
-        t = _sel(FP2, take, t_add, t)
-        f = fp12_select(take, f_add, f)
+
+        def do_add(tf):
+            ti, fi = tf
+            ti, (b0, b3, b5) = _add_step(ti, qx, qy, px, py)
+            return ti, _mul_by_line(fi, b0, b3, b5)
+
+        t, f = lax.cond(bit != 0, do_add, lambda tf: tf, (t, f))
         return (t, f), None
 
     (_, f), _ = lax.scan(step, (t0, f0), jnp.asarray(_ML_BITS))
@@ -220,14 +228,16 @@ _ABS_X_BITS_MSB = np.array(
 
 
 def _pow_abs_x(g):
-    """g^|z| in the cyclotomic subgroup (square-and-multiply scan)."""
+    """g^|z| in the cyclotomic subgroup. |z| is the same sparse static
+    constant as the Miller loop: square every step, multiply behind a
+    lax.cond that fires on the 5 set bits only."""
 
     def step(acc, bit):
         acc = fp12_sqr(acc)
-        return fp12_select(jnp.broadcast_to(bit != 0, acc.shape[:-4]), fp12_mul(acc, g), acc), None
+        acc = lax.cond(bit != 0, lambda a: fp12_mul(a, g), lambda a: a, acc)
+        return acc, None
 
-    one = fp12_one(g.shape[:-4])
-    acc, _ = lax.scan(step, one, jnp.asarray(_ABS_X_BITS_MSB))
+    acc, _ = lax.scan(step, g, jnp.asarray(_ABS_X_BITS_MSB[1:]))
     return acc
 
 

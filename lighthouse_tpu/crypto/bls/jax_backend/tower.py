@@ -293,6 +293,52 @@ def fp12_mul(a, b):
     return _from_flat(_flat_mul(_to_flat(a), _to_flat(b)))
 
 
+_SQR_PAIRS = [(i, j) for i in range(6) for j in range(i, 6)]  # 21 unordered
+
+
+def _flat_sqr(af):
+    """Squaring of a flat Fp12 element: symmetry cuts the 36 ordered
+    coefficient products to 21 unordered ones (off-diagonal terms doubled
+    after the stacked pass1, which keeps columns < 2^21 — the anti-diagonal
+    fold magnitudes match _flat_mul's ordered-pair counts exactly, so the
+    same redc(mult=7) bound applies)."""
+    ii = np.array([i for i, _ in _SQR_PAIRS])
+    jj = np.array([j for _, j in _SQR_PAIRS])
+    dbl = np.array([2 if i < j else 1 for i, j in _SQR_PAIRS], dtype=np.int32)
+    s = fp.pass1(af[..., 0, :] + af[..., 1, :])  # (..., 6, 32)
+    La = af[..., ii, :, :]
+    Rb = af[..., jj, :, :]
+    L3 = jnp.stack([La[..., 0, :], La[..., 1, :], s[..., ii, :]], axis=-2)
+    R3 = jnp.stack([Rb[..., 0, :], Rb[..., 1, :], s[..., jj, :]], axis=-2)
+    t = fp.poly(L3, R3)  # (..., 21, 3, 63)
+    t0, t1, t2 = t[..., 0, :], t[..., 1, :], t[..., 2, :]
+    c0 = fp._pad_to(t0 - t1, 64) + jnp.asarray(fp.OFF_2PP)
+    c1 = fp._pad_to(t2 - (t0 + t1), 64)
+    cc = fp.pass1(jnp.stack([c0, c1], axis=-2))  # (..., 21, 2, 64)
+    cc = cc * dbl[:, None, None]
+
+    d = [None] * 11
+    for q, (i, j) in enumerate(_SQR_PAIRS):
+        k = i + j
+        term = cc[..., q, :, :]
+        d[k] = term if d[k] is None else d[k] + term
+    zeros = jnp.zeros_like(cc[..., 0, :, :])
+    d = [zeros if x is None else x for x in d]
+
+    out = []
+    off16 = jnp.asarray(_OFF16PP)
+    for k in range(6):
+        if k < 5:
+            hi0, hi1 = d[k + 6][..., 0, :], d[k + 6][..., 1, :]
+            e0 = d[k][..., 0, :] + hi0 - hi1 + off16
+            e1 = d[k][..., 1, :] + hi0 + hi1
+            out.append(jnp.stack([e0, e1], axis=-2))
+        else:
+            out.append(d[k] + off16 * 0)
+    e = jnp.stack(out, axis=-3)
+    return fp.redc(e, mult=7)
+
+
 def fp12_mul_sparse035(a, b0, b3, b5):
     """a * (B0 + B3 w^3 + B5 w^5) for Fp2 coefficients B_i — the pairing
     line-value shape; 18 instead of 36 Fp2 products."""
@@ -304,7 +350,7 @@ def fp12_mul_sparse035(a, b0, b3, b5):
 
 
 def fp12_sqr(a):
-    return fp12_mul(a, a)
+    return _from_flat(_flat_sqr(_to_flat(a)))
 
 
 def fp12_conj(a):

@@ -1,0 +1,11 @@
+"""Operation pool (SURVEY.md §2.3): block-packing of pending operations.
+
+Counterpart of /root/reference/beacon_node/operation_pool: greedy weighted
+maximum-coverage attestation packing (max_cover.rs:48), aggregate-on-insert
+attestation storage, slashing/exit dedup + validity filtering.
+"""
+
+from .max_cover import maximum_cover
+from .pool import OperationPool
+
+__all__ = ["maximum_cover", "OperationPool"]

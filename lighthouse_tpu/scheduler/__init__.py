@@ -1,0 +1,19 @@
+"""Work scheduling: the BeaconProcessor priority-queue/batch-formation layer
+(SURVEY.md §2.8-3), retuned for TPU batch buckets.
+"""
+
+from .beacon_processor import (
+    Batch,
+    BeaconProcessor,
+    MAX_GOSSIP_AGGREGATE_BATCH_SIZE,
+    MAX_GOSSIP_ATTESTATION_BATCH_SIZE,
+    WorkType,
+)
+
+__all__ = [
+    "Batch",
+    "BeaconProcessor",
+    "MAX_GOSSIP_AGGREGATE_BATCH_SIZE",
+    "MAX_GOSSIP_ATTESTATION_BATCH_SIZE",
+    "WorkType",
+]

@@ -31,6 +31,7 @@ from .types import (
     Container,
     DeserializationError,
     List,
+    Union,
     Vector,
     boolean,
     uint8,

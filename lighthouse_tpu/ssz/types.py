@@ -378,6 +378,74 @@ def _bytes_to_bits(data: bytes):
     return [bool((byte >> i) & 1) for byte in data for i in range(8)]
 
 
+# -- union ---------------------------------------------------------------------
+
+
+class Union:
+    """SSZ Union: 1-byte selector prefix + encoded variant; tree root mixes
+    the selector into the variant root (consensus/ssz/src/decode.rs union
+    handling; used by the spec's Transaction and fork-multiplexed types).
+
+    `options` is the ordered variant-type list; `None` as option 0 encodes
+    the spec's `Union[None, T, ...]` null arm (empty body, zero-hash root).
+    Values are (selector, value) pairs."""
+
+    MAX_OPTIONS = 128
+
+    def __init__(self, options: list):
+        if not options:
+            raise ValueError("Union needs at least one option")
+        if len(options) > self.MAX_OPTIONS:
+            raise ValueError("Union supports at most 128 options")
+        if any(o is None for o in options[1:]):
+            raise ValueError("None is only allowed as option 0")
+        if options[0] is None and len(options) == 1:
+            raise ValueError("Union[None] alone is not allowed")
+        self.options = list(options)
+
+    def __repr__(self):
+        return f"Union({self.options!r})"
+
+    def is_fixed_size(self) -> bool:
+        return False  # selector makes every union variable-size
+
+    def serialize(self, v) -> bytes:
+        selector, value = v
+        if not 0 <= selector < len(self.options):
+            raise ValueError(f"Union selector {selector} out of range")
+        opt = self.options[selector]
+        if opt is None:
+            if value is not None:
+                raise ValueError("Union null arm carries no value")
+            return bytes([0])
+        return bytes([selector]) + opt.serialize(value)
+
+    def deserialize(self, data: bytes):
+        if not data:
+            raise DeserializationError("Union: empty input")
+        selector = data[0]
+        if selector >= len(self.options):
+            raise DeserializationError(f"Union: invalid selector {selector}")
+        opt = self.options[selector]
+        if opt is None:
+            if len(data) != 1:
+                raise DeserializationError("Union: null arm with trailing bytes")
+            return (0, None)
+        return (selector, opt.deserialize(data[1:]))
+
+    def hash_tree_root(self, v) -> bytes:
+        selector, value = v
+        if not 0 <= selector < len(self.options):
+            raise ValueError(f"Union selector {selector} out of range")
+        opt = self.options[selector]
+        body = b"\x00" * BYTES_PER_CHUNK if opt is None else opt.hash_tree_root(value)
+        return mix_in_selector(body, selector)
+
+    def default(self):
+        opt = self.options[0]
+        return (0, None if opt is None else opt.default())
+
+
 # -- containers ----------------------------------------------------------------
 
 

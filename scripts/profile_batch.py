@@ -69,13 +69,15 @@ def main() -> None:
     t_pack_g1 = med(lambda: pack_g1_batch(pk_pts))
     t_pack_g2 = med(lambda: pack_g2_batch(sig_pts))
 
-    t_put = med(lambda: jax.block_until_ready([jnp.asarray(a) for a in staged]))
-    dev = [jnp.asarray(a) for a in staged]
+    flat = japi._pack_staged(staged)
+    t_pack = med(lambda: japi._pack_staged(staged))
+    t_put = med(lambda: jax.block_until_ready(jnp.asarray(flat)))
+    dev = jnp.asarray(flat)
     jax.block_until_ready(dev)
 
     kernel = japi._verify_kernel(S, K)
-    jax.block_until_ready(kernel(*dev))  # warm this exact shape
-    t_exec = med(lambda: jax.block_until_ready(kernel(*dev)))
+    jax.block_until_ready(kernel(dev))  # warm this exact shape
+    t_exec = med(lambda: jax.block_until_ready(kernel(dev)))
 
     t_full = med(lambda: b.verify_signature_sets(sets))
 
@@ -84,6 +86,7 @@ def main() -> None:
         ("  of which hash_to_field", t_h2f),
         ("  of which pack_g1 x%d" % len(pk_pts), t_pack_g1),
         ("  of which pack_g2 x%d" % len(sig_pts), t_pack_g2),
+        ("flat pack (host)", t_pack),
         ("device_put", t_put),
         ("device execute", t_exec),
         ("full verify_signature_sets", t_full),

@@ -1,0 +1,58 @@
+"""Quick on-device differential smoke of the jax backend (valid + tampered).
+
+Exercises hash-to-G2, subgroup checks, ladders, Miller loop, final exp on
+the attached accelerator in the (4,1) and (8,1) buckets. Full differential
+coverage lives in tests/ (CPU mesh); this is the fast iteration loop for
+kernel work.
+"""
+
+import os
+import pathlib
+import sys
+import time
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_ROOT))
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(_ROOT / ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+
+def main() -> None:
+    from lighthouse_tpu.crypto import bls
+
+    j = bls.backend("jax")
+    sk0, pk0 = j.interop_keypair(0)
+    sk1, pk1 = j.interop_keypair(1)
+    msg = b"\x11" * 32
+
+    t0 = time.perf_counter()
+    sig = sk0.sign(msg)
+    assert sig.verify(pk0, msg), "valid verify failed"
+    print(f"first verify (compile+run): {time.perf_counter() - t0:.1f}s")
+    assert not sig.verify(pk1, msg), "wrong-key verify passed"
+    assert not sig.verify(pk0, b"\x22" * 32), "wrong-msg verify passed"
+    agg = j.aggregate_signatures([sk0.sign(msg), sk1.sign(msg)])
+    assert agg.fast_aggregate_verify([pk0, pk1], msg), "fast_aggregate failed"
+
+    sets = [
+        j.SignatureSet(
+            signature=(sk0 if i % 2 == 0 else sk1).sign(bytes([i]) * 32),
+            signing_keys=[pk0 if i % 2 == 0 else pk1],
+            message=bytes([i]) * 32,
+        )
+        for i in range(8)
+    ]
+    t0 = time.perf_counter()
+    assert j.verify_signature_sets(sets), "batch verify failed"
+    print(f"8-batch verify (compile+run): {time.perf_counter() - t0:.1f}s")
+    bad = list(sets)
+    bad[3] = j.SignatureSet(
+        signature=sets[2].signature, signing_keys=sets[3].signing_keys, message=sets[3].message
+    )
+    assert not j.verify_signature_sets(bad), "tampered batch passed"
+    print("TPU differential smoke: all ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Metrics registry, safe arithmetic, merkle proofs."""
+
+import hashlib
+
+import pytest
+
+from lighthouse_tpu.common.metrics import Registry
+from lighthouse_tpu.ssz.hash import ZERO_HASHES
+from lighthouse_tpu.ssz.merkle_proof import (
+    MerkleTree,
+    deposit_root,
+    deposit_tree_proof,
+    verify_merkle_proof,
+)
+from lighthouse_tpu.utils.safe_arith import (
+    ArithError,
+    UINT64_MAX,
+    safe_add,
+    safe_div,
+    safe_mul,
+    safe_sub,
+    saturating_add,
+    saturating_sub,
+)
+
+
+def test_counters_gauges():
+    r = Registry()
+    c = r.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    g = r.gauge("g", "a gauge")
+    g.set(5)
+    g.dec()
+    assert g.value == 4
+    assert r.counter("c_total") is c  # idempotent registration
+    with pytest.raises(ValueError):
+        r.gauge("c_total")
+
+
+def test_histogram_and_exposition():
+    r = Registry()
+    h = r.histogram("h_seconds", "latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    with h.time():
+        pass
+    text = r.gather()
+    assert 'h_seconds_bucket{le="+Inf"} 4' in text
+    assert "h_seconds_count 4" in text
+    assert "# TYPE h_seconds histogram" in text
+
+
+def test_safe_arith():
+    assert safe_add(1, 2) == 3
+    with pytest.raises(ArithError):
+        safe_add(UINT64_MAX, 1)
+    with pytest.raises(ArithError):
+        safe_sub(1, 2)
+    with pytest.raises(ArithError):
+        safe_mul(2**63, 2)
+    with pytest.raises(ArithError):
+        safe_div(1, 0)
+    assert saturating_add(UINT64_MAX, 5) == UINT64_MAX
+    assert saturating_sub(3, 5) == 0
+
+
+def h2(a, b):
+    return hashlib.sha256(a + b).digest()
+
+
+def test_merkle_tree_known_small():
+    l0, l1, l2 = b"\x01" * 32, b"\x02" * 32, b"\x03" * 32
+    t = MerkleTree([l0, l1, l2], depth=2)
+    expect = h2(h2(l0, l1), h2(l2, ZERO_HASHES[0]))
+    assert t.root == expect
+    for i, leaf in enumerate([l0, l1, l2]):
+        proof = t.proof(i)
+        assert verify_merkle_proof(leaf, proof, 2, i, t.root)
+    # wrong index fails
+    assert not verify_merkle_proof(l0, t.proof(0), 2, 1, t.root)
+
+
+def test_empty_tree_is_zero_hash():
+    t = MerkleTree([], depth=5)
+    assert t.root == ZERO_HASHES[5]
+
+
+def test_deposit_proof_matches_process_deposit_semantics():
+    """deposit_tree_proof/deposit_root must satisfy the depth+1 branch check
+    used by state_transition.per_block.process_deposit."""
+    from lighthouse_tpu.state_transition.per_block import _verify_merkle_branch
+
+    leaves = [bytes([i]) * 32 for i in range(5)]
+    depth = 32
+    t = MerkleTree(leaves, depth)
+    count = len(leaves)
+    root = deposit_root(t, count)
+    for i, leaf in enumerate(leaves):
+        proof = deposit_tree_proof(t, i, count)
+        assert _verify_merkle_branch(leaf, proof, depth + 1, i, root)
+    assert not _verify_merkle_branch(leaves[0], deposit_tree_proof(t, 0, count), depth + 1, 1, root)
+
+
+def test_push_updates_root():
+    t = MerkleTree([b"\x01" * 32], depth=3)
+    r1 = t.root
+    t.push(b"\x02" * 32)
+    assert t.root != r1
+    assert verify_merkle_proof(b"\x02" * 32, t.proof(1), 3, 1, t.root)

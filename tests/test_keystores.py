@@ -1,0 +1,85 @@
+"""EIP-2333 key derivation, EIP-2335 keystores, EIP-2386 wallets.
+
+The EIP-2333 known-answer test uses the test case published in the EIP
+itself (public vector), pinning master- and child-key derivation.
+"""
+
+import pytest
+
+from lighthouse_tpu.crypto import key_derivation as kd
+from lighthouse_tpu.crypto import keystore as ks
+from lighthouse_tpu.crypto.wallet import Wallet
+
+EIP2333_SEED = bytes.fromhex(
+    "c55257c360c07c72029aebc1b53c05ed0362ada38ead3e3e9efa3708e53495531f09a698"
+    "7599d18264c1e1c92f2cf141630c7a3c4ab7c81b2f001698e7463b04"
+)
+EIP2333_MASTER_SK = 6083874454709270928345386274498605044986640685124978867557563392430687146096
+EIP2333_CHILD_INDEX = 0
+EIP2333_CHILD_SK = 20397789859736650942317412262472558107875392172444076792671091975210932703118
+
+
+def test_eip2333_known_answer():
+    master = kd.derive_master_sk(EIP2333_SEED)
+    assert master == EIP2333_MASTER_SK
+    child = kd.derive_child_sk(master, EIP2333_CHILD_INDEX)
+    assert child == EIP2333_CHILD_SK
+
+
+def test_derive_path_and_short_seed():
+    sk = kd.derive_path(EIP2333_SEED, "m/12381/3600/0/0/0")
+    assert 0 < sk
+    with pytest.raises(ValueError):
+        kd.derive_master_sk(b"short")
+    with pytest.raises(ValueError):
+        kd.derive_path(EIP2333_SEED, "x/1")
+    assert kd.validator_signing_path(3) == "m/12381/3600/3/0/0"
+
+
+FAST_KDF = {"c": 2**10, "dklen": 32}
+
+
+def test_keystore_roundtrip_pbkdf2():
+    secret = bytes(range(32))
+    store = ks.encrypt(secret, "pa55word", kdf_function="pbkdf2", kdf_params=dict(FAST_KDF))
+    assert store["version"] == 4
+    assert ks.decrypt(store, "pa55word") == secret
+    with pytest.raises(ks.KeystoreError, match="checksum"):
+        ks.decrypt(store, "wrong")
+
+
+def test_keystore_roundtrip_scrypt():
+    secret = b"\x07" * 32
+    store = ks.encrypt(
+        secret, "p", kdf_function="scrypt", kdf_params={"n": 2**10, "r": 8, "p": 1, "dklen": 32}
+    )
+    assert ks.decrypt(store, "p") == secret
+
+
+def test_keystore_password_normalization():
+    # EIP-2335: control characters are stripped before KDF
+    secret = b"\x01" * 32
+    store = ks.encrypt(secret, "pass\x7fword", kdf_function="pbkdf2", kdf_params=dict(FAST_KDF))
+    assert ks.decrypt(store, "password") == secret
+
+
+def test_keystore_file_roundtrip(tmp_path):
+    secret = b"\x02" * 32
+    store = ks.encrypt(secret, "pw", kdf_function="pbkdf2", kdf_params=dict(FAST_KDF))
+    path = tmp_path / "keystore.json"
+    ks.save(store, str(path))
+    assert ks.decrypt(ks.load(str(path)), "pw") == secret
+
+
+def test_wallet_derives_sequential_validators():
+    w = Wallet.create("w1", "wpass", seed=EIP2333_SEED, kdf_params=dict(FAST_KDF))
+    ks1, i1 = w.next_validator("wpass", "kpass")
+    ks2, i2 = w.next_validator("wpass", "kpass")
+    assert (i1, i2) == (0, 1)
+    assert w.data["nextaccount"] == 2
+    sk1 = int.from_bytes(ks.decrypt(ks1, "kpass"), "big")
+    # wallet derivation must equal direct EIP-2334 path derivation
+    assert sk1 == kd.derive_path(EIP2333_SEED, "m/12381/3600/0/0/0")
+    assert ks1["path"] == "m/12381/3600/0/0/0"
+    sk2 = int.from_bytes(ks.decrypt(ks2, "kpass"), "big")
+    assert sk1 != sk2

@@ -1,0 +1,103 @@
+"""Operation pool tests: max-cover packing, aggregate-on-insert, dedup."""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.op_pool import OperationPool, maximum_cover
+from lighthouse_tpu.state_transition import TransitionContext
+from lighthouse_tpu.types import MINIMAL_PRESET
+
+
+def test_maximum_cover_prefers_coverage():
+    items = {
+        "a": {1: 10, 2: 10},
+        "b": {2: 10, 3: 10},
+        "c": {1: 10, 2: 10, 3: 10},
+        "d": {9: 1},
+    }
+    got = maximum_cover(items, covering=lambda k: items[k], limit=2)
+    assert got[0] == "c"  # best single coverage
+    assert got[1] == "d"  # a/b add nothing once c is picked; d adds weight 1
+
+
+def test_maximum_cover_respects_limit_and_drops_empty():
+    items = {"a": {1: 5}, "b": {1: 5}, "c": {}}
+    got = maximum_cover(items, covering=lambda k: items[k], limit=5)
+    assert got == ["a"]  # b fully covered by a; c has nothing
+
+
+@pytest.fixture(scope="module")
+def harness():
+    h = BeaconChainHarness(16, TransitionContext.minimal("fake"))
+    h.extend_chain(2)
+    return h
+
+
+def test_aggregate_on_insert(harness):
+    h = harness
+    ctx = h.ctx
+    pool = OperationPool(ctx)
+    head = h.chain.head_root
+    state = h.chain.store.get_state(head)
+    atts = h.attestations_for_slot(state, head, int(state.slot))
+    base = atts[0]
+    n = len(base.aggregation_bits)
+    assert n >= 2
+    # split the committee into two disjoint halves
+    half1 = ctx.types.Attestation(
+        aggregation_bits=[i < n // 2 for i in range(n)],
+        data=base.data,
+        signature=bytes(base.signature),
+    )
+    half2 = ctx.types.Attestation(
+        aggregation_bits=[i >= n // 2 for i in range(n)],
+        data=base.data,
+        signature=bytes(base.signature),
+    )
+    pool.insert_attestation(half1)
+    pool.insert_attestation(half2)
+    root = ctx.types.AttestationData.hash_tree_root(base.data)
+    assert len(pool.attestations[root]) == 1  # merged
+    assert all(pool.attestations[root][0].aggregation_bits)
+    # overlapping attestation cannot merge: second entry
+    pool.insert_attestation(half1)
+    assert len(pool.attestations[root]) == 2
+
+
+def test_get_attestations_packs_fresh_coverage(harness):
+    h = harness
+    pool = OperationPool(h.ctx)
+    head = h.chain.head_root
+    state = h.chain.store.get_state(head).copy()
+    from lighthouse_tpu.state_transition import process_slots
+
+    slot = int(state.slot)
+    atts = h.attestations_for_slot(state, head, slot)
+    for a in atts:
+        pool.insert_attestation(a)
+    process_slots(state, slot + 1, h.ctx)  # make them includable
+    packed = pool.get_attestations(state)
+    assert len(packed) == len(atts)  # every committee contributes fresh indices
+    # prune: far-future state drops everything
+    future = state.copy()
+    future.slot = slot + 10 * MINIMAL_PRESET.slots_per_epoch
+    pool.prune(future)
+    assert not pool.attestations
+
+
+def test_exit_dedup_and_filtering(harness):
+    h = harness
+    ctx = h.ctx
+    pool = OperationPool(ctx)
+    state = h.chain.head_state().copy()
+    # validators too young for exits (shard_committee_period): filtered out
+    from lighthouse_tpu.types.containers import SignedVoluntaryExit, VoluntaryExit
+
+    ex = SignedVoluntaryExit(
+        message=VoluntaryExit(epoch=0, validator_index=1), signature=b"\x00" * 96
+    )
+    pool.insert_voluntary_exit(ex)
+    pool.insert_voluntary_exit(ex)  # dedup by validator index
+    assert len(pool.voluntary_exits) == 1
+    _, _, exits = pool.get_slashings_and_exits(state)
+    assert exits == []  # activation_epoch + shard_committee_period > current

@@ -214,3 +214,81 @@ def test_merkleize_zero_cases():
     assert ssz.merkleize([], limit=4) == ssz.ZERO_HASHES[2]
     with pytest.raises(ValueError):
         ssz.merkleize([b"\x00" * 32] * 3, limit=2)
+
+
+# -- Union ---------------------------------------------------------------------
+
+
+def test_union_roundtrip_and_selector_prefix():
+    from lighthouse_tpu.ssz import Union, uint16
+
+    u = Union([uint64, uint16])
+    data = u.serialize((1, 7))
+    assert data == b"\x01" + (7).to_bytes(2, "little")
+    assert u.deserialize(data) == (1, 7)
+    data0 = u.serialize((0, 9))
+    assert data0[0] == 0
+    assert u.deserialize(data0) == (0, 9)
+
+
+def test_union_null_arm():
+    from lighthouse_tpu.ssz import Union
+
+    u = Union([None, uint64])
+    assert u.serialize((0, None)) == b"\x00"
+    assert u.deserialize(b"\x00") == (0, None)
+    assert u.default() == (0, None)
+    # null arm root = zero chunk mixed with selector 0
+    assert u.hash_tree_root((0, None)) == hashlib.sha256(
+        b"\x00" * 32 + (0).to_bytes(32, "little")
+    ).digest()
+
+
+def test_union_root_mixes_selector():
+    from lighthouse_tpu.ssz import Union, uint16
+
+    u = Union([uint64, uint16])
+    # independent recomputation with plain hashlib
+    body = (7).to_bytes(2, "little") + b"\x00" * 30
+    expect = hashlib.sha256(body + (1).to_bytes(32, "little")).digest()
+    assert u.hash_tree_root((1, 7)) == expect
+    # same value under a different selector must hash differently
+    assert u.hash_tree_root((1, 7)) != u.hash_tree_root((0, 7))
+
+
+def test_union_rejects_invalid():
+    from lighthouse_tpu.ssz import DeserializationError, Union, uint16
+
+    u = Union([uint64, uint16])
+    with pytest.raises(DeserializationError):
+        u.deserialize(b"")  # empty
+    with pytest.raises(DeserializationError):
+        u.deserialize(b"\x05" + b"\x00" * 8)  # selector out of range
+    with pytest.raises(DeserializationError):
+        u.deserialize(b"\x00" + b"\x00" * 3)  # wrong body length for uint64
+    with pytest.raises(ValueError):
+        u.serialize((9, 0))  # bad selector on encode
+    nullable = Union([None, uint64])
+    with pytest.raises(DeserializationError):
+        nullable.deserialize(b"\x00\x01")  # null arm with trailing bytes
+    with pytest.raises(ValueError):
+        Union([uint64, None])  # None only allowed first
+    with pytest.raises(ValueError):
+        Union([])
+
+
+def test_union_inside_container():
+    from lighthouse_tpu.ssz import Container, Union, uint16
+
+    u = Union([None, uint64])
+
+    class Holder(Container):
+        fields = [("a", uint16), ("x", u)]
+
+    h1 = Holder(a=3, x=(1, 99))
+    data = Holder.serialize(h1)
+    back = Holder.deserialize(data)
+    assert back == h1
+    h0 = Holder(a=3, x=(0, None))
+    assert Holder.hash_tree_root(h0) != Holder.hash_tree_root(h1)
+    assert Holder.deserialize(Holder.serialize(h0)) == h0

@@ -217,3 +217,65 @@ def test_bulk_verifier_uses_single_batch_call(fake_ctx):
     # exactly one batch: proposal + randao + N attestations in a single call
     assert len(calls) == 1
     assert calls[0] == 2 + len(atts)
+
+
+def test_deposit_flow_grows_registry(fake_ctx):
+    """End-to-end deposit: build a deposit tree, prove against the state's
+    eth1_data root, include in a block, registry + balance grow."""
+    from lighthouse_tpu.ssz.merkle_proof import MerkleTree, deposit_root, deposit_tree_proof
+    from lighthouse_tpu.types import DEPOSIT_CONTRACT_TREE_DEPTH
+    from lighthouse_tpu.types.containers import DepositData
+    from lighthouse_tpu.types.containers import Deposit
+
+    h = make_harness(16, fake_ctx)
+    chain = h.chain
+    sk, pk = fake_ctx.bls.interop_keypair(99)
+    dd = DepositData(
+        pubkey=pk.to_bytes(),
+        withdrawal_credentials=b"\x00" * 32,
+        amount=32_000_000_000,
+        signature=sk.sign(b"x").to_bytes(),  # fake backend: always valid
+    )
+    # the contract tree holds the 16 genesis deposits (dummy leaves here —
+    # the state only checks from its own eth1_deposit_index onward) plus ours
+    leaf = DepositData.hash_tree_root(dd)
+    n_genesis = len(chain.head_state().validators)
+    tree = MerkleTree([b"\x55" * 32] * n_genesis + [leaf], DEPOSIT_CONTRACT_TREE_DEPTH)
+    count = n_genesis + 1
+
+    from lighthouse_tpu.chain import BeaconChain
+    from lighthouse_tpu.state_transition import interop_genesis_state
+    from lighthouse_tpu.types.containers import Eth1Data
+
+    genesis = interop_genesis_state(16, 1600000000, fake_ctx)
+    genesis.eth1_data = Eth1Data(
+        deposit_root=deposit_root(tree, count),
+        deposit_count=count,
+        block_hash=b"\x42" * 32,
+    )
+    genesis.eth1_deposit_index = n_genesis
+    chain = BeaconChain(genesis, fake_ctx)
+    h.chain = chain
+
+    dep = Deposit(
+        proof=deposit_tree_proof(tree, n_genesis, count),
+        data=dd,
+    )
+    # wrong proof index must fail during production (process_deposit)
+    state1 = chain.state_at_slot(1)
+    proposer = get_beacon_proposer_index(state1, fake_ctx.preset, fake_ctx.spec)
+    reveal = h.randao_reveal(state1, proposer, 1)
+    with pytest.raises(StateTransitionError, match="merkle|deposits"):
+        bad = Deposit(proof=[b"\x00" * 32] * 33, data=dd)
+        chain.produce_block_on_state(chain.state_at_slot(1), 1, reveal, deposits=[bad])
+
+    # correct proof: block applies, validator appended
+    n_before = len(chain.head_state().validators)
+    block, _ = chain.produce_block_on_state(chain.state_at_slot(1), 1, reveal, deposits=[dep])
+    signed = chain.sign_block(block, h.keypairs[proposer][0])
+    chain.slot_clock.set_slot(1)
+    root = chain.process_block(signed)
+    after = chain.store.get_state(root)
+    assert len(after.validators) == n_before + 1
+    assert bytes(after.validators[-1].pubkey) == pk.to_bytes()
+    assert after.balances[-1] == 32_000_000_000
