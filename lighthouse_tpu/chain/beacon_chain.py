@@ -79,6 +79,8 @@ class BeaconChain:
         signed_block,
         strategy: BlockSignatureStrategy = BlockSignatureStrategy.VERIFY_BULK,
     ) -> bytes:
+        from ..common.metrics import BLOCK_IMPORT_SECONDS
+
         t = self.ctx.types
         block = signed_block.message
         parent_root = bytes(block.parent_root)
@@ -86,11 +88,12 @@ class BeaconChain:
         if parent_state is None:
             raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
 
-        state = parent_state.copy()
-        try:
-            state_transition(state, signed_block, self.ctx, strategy=strategy)
-        except StateTransitionError as e:
-            raise BlockError(str(e)) from e
+        with BLOCK_IMPORT_SECONDS.time():
+            state = parent_state.copy()
+            try:
+                state_transition(state, signed_block, self.ctx, strategy=strategy)
+            except StateTransitionError as e:
+                raise BlockError(str(e)) from e
 
         block_root = t.BeaconBlock.hash_tree_root(block)
         self.store.put_block(block_root, signed_block)

@@ -34,10 +34,12 @@ class Counter(Metric):
         return self._value
 
     def expose(self) -> str:
+        with self._lock:
+            v = self._value
         return (
             f"# HELP {self.name} {self.help}\n"
             f"# TYPE {self.name} counter\n"
-            f"{self.name} {self._value}\n"
+            f"{self.name} {v}\n"
         )
 
 
@@ -62,10 +64,12 @@ class Gauge(Metric):
         return self._value
 
     def expose(self) -> str:
+        with self._lock:
+            v = self._value
         return (
             f"# HELP {self.name} {self.help}\n"
             f"# TYPE {self.name} gauge\n"
-            f"{self.name} {self._value}\n"
+            f"{self.name} {v}\n"
         )
 
 
@@ -107,18 +111,21 @@ class Histogram(Metric):
         return self._sum
 
     def expose(self) -> str:
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, n = self._sum, self._n
         lines = [
             f"# HELP {self.name} {self.help}",
             f"# TYPE {self.name} histogram",
         ]
         cumulative = 0
-        for b, c in zip(self.buckets, self._counts):
+        for b, c in zip(self.buckets, counts):
             cumulative += c
             lines.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
-        cumulative += self._counts[-1]
+        cumulative += counts[-1]
         lines.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
-        lines.append(f"{self.name}_sum {self._sum}")
-        lines.append(f"{self.name}_count {self._n}")
+        lines.append(f"{self.name}_sum {total_sum}")
+        lines.append(f"{self.name}_count {n}")
         return "\n".join(lines) + "\n"
 
 

@@ -356,9 +356,14 @@ def verify_signature_sets(sets: list[SignatureSet], rng=None) -> bool:
         if any(pk.point.inf for pk in s.signing_keys):
             return False
 
-    staged = stage_sets(sets, rng=rng)
-    kernel = _verify_kernel(staged[2].shape[0], staged[2].shape[1])
-    return bool(kernel(jnp.asarray(_pack_staged(staged))))
+    from ...common.metrics import BLS_BATCH_SECONDS, BLS_SETS_TOTAL
+
+    with BLS_BATCH_SECONDS.time():
+        staged = stage_sets(sets, rng=rng)
+        kernel = _verify_kernel(staged[2].shape[0], staged[2].shape[1])
+        ok = bool(kernel(jnp.asarray(_pack_staged(staged))))
+    BLS_SETS_TOTAL.inc(len(sets))
+    return ok
 
 
 # -- pubkey validation (cache-admission path) ----------------------------------

@@ -102,7 +102,9 @@ def decrypt(keystore: dict, password: str) -> bytes:
     dk = _kdf(_normalize_password(password), crypto["kdf"])
     ciphertext = bytes.fromhex(crypto["cipher"]["message"])
     checksum = hashlib.sha256(dk[16:32] + ciphertext).digest()
-    if checksum.hex() != crypto["checksum"]["message"]:
+    import hmac as _hmac_mod
+
+    if not _hmac_mod.compare_digest(checksum, bytes.fromhex(crypto["checksum"]["message"])):
         raise KeystoreError("invalid password (checksum mismatch)")
     iv = bytes.fromhex(crypto["cipher"]["params"]["iv"])
     return _aes128ctr(dk[:16], iv, ciphertext)

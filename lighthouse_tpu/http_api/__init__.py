@@ -1,0 +1,7 @@
+"""Eth2 Beacon API server subset + metrics scrape (SURVEY.md §2.3 http_api
+/ http_metrics)."""
+
+from .json_codec import decode, encode
+from .server import ApiError, HttpApiServer
+
+__all__ = ["ApiError", "HttpApiServer", "decode", "encode"]

@@ -1,0 +1,304 @@
+"""Eth2 Beacon API HTTP server (subset) + Prometheus /metrics.
+
+Counterpart of /root/reference/beacon_node/http_api (lib.rs:243 serve) and
+http_metrics — stdlib ThreadingHTTPServer, no framework. The endpoint set
+is the slice a validator client needs (SURVEY.md §7 Phase 4: "enough for a
+VC: duties, attestation data, block production, publish") plus node/chain
+introspection:
+
+  GET  /eth/v1/node/health | /eth/v1/node/version | /eth/v1/node/syncing
+  GET  /eth/v1/beacon/genesis
+  GET  /eth/v1/beacon/states/{state_id}/finality_checkpoints
+  GET  /eth/v1/beacon/states/{state_id}/root
+  GET  /eth/v1/beacon/headers/{block_id}
+  POST /eth/v1/beacon/pool/attestations
+  POST /eth/v1/beacon/blocks
+  GET  /eth/v1/validator/duties/proposer/{epoch}
+  POST /eth/v1/validator/duties/attester/{epoch}
+  GET  /eth/v1/validator/attestation_data?slot=&committee_index=
+  GET  /eth/v2/validator/blocks/{slot}?randao_reveal=
+  GET  /metrics        (Prometheus text; http_metrics' scrape surface)
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..chain.beacon_chain import BlockError
+from ..common.metrics import REGISTRY
+from ..state_transition.helpers import StateTransitionError
+from ..types import compute_epoch_at_slot
+from ..types.containers import BeaconBlockHeader
+from .json_codec import decode, encode
+
+
+class ApiError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+def _data(payload) -> bytes:
+    return json.dumps({"data": payload}).encode()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    api = None  # BeaconNodeApi, injected by serve()
+    chain = None
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _send(self, status: int, body: bytes, content_type: str = "application/json"):
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, status: int, message: str):
+        self._send(status, json.dumps({"code": status, "message": message}).encode())
+
+    def _state_for(self, state_id: str):
+        chain = self.chain
+        if state_id in ("head", "justified", "finalized"):
+            if state_id == "head":
+                return chain.head_state()
+            cp = (
+                chain.fork_choice.justified_checkpoint
+                if state_id == "justified"
+                else chain.fork_choice.finalized_checkpoint
+            )
+            st = chain.store.get_state(bytes(cp.root))
+            if st is None:
+                raise ApiError(404, "state not found")
+            return st
+        if state_id == "genesis":
+            st = chain.store.get_state(chain.genesis_block_root)
+            if st is None:
+                raise ApiError(404, "state not found")
+            return st
+        if state_id.startswith("0x"):
+            st = chain.store.get_state(bytes.fromhex(state_id[2:]))
+            if st is None:
+                raise ApiError(404, "state not found")
+            return st
+        raise ApiError(400, f"unsupported state id {state_id}")
+
+    # -- GET ---------------------------------------------------------------
+
+    def do_GET(self):
+        try:
+            url = urlparse(self.path)
+            parts = [p for p in url.path.split("/") if p]
+            q = parse_qs(url.query)
+            self._route_get(parts, q)
+        except ApiError as e:
+            self._error(e.status, str(e))
+        except Exception as e:  # noqa: BLE001 - surface as 500, don't kill the server
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def _route_get(self, parts, q):
+        chain, api, ctx = self.chain, self.api, self.chain.ctx
+        t = ctx.types
+        if parts == ["metrics"]:
+            self._send(200, REGISTRY.gather().encode(), "text/plain; version=0.0.4")
+        elif parts == ["eth", "v1", "node", "health"]:
+            self._send(200, b"")
+        elif parts == ["eth", "v1", "node", "version"]:
+            self._send(200, _data({"version": "lighthouse-tpu/0.4.0"}))
+        elif parts == ["eth", "v1", "node", "syncing"]:
+            self._send(
+                200,
+                _data(
+                    {
+                        "head_slot": str(chain.head_state().slot),
+                        "sync_distance": "0",
+                        "is_syncing": False,
+                        "is_optimistic": False,
+                    }
+                ),
+            )
+        elif parts == ["eth", "v1", "beacon", "genesis"]:
+            st = chain.store.get_state(chain.genesis_block_root)
+            self._send(
+                200,
+                _data(
+                    {
+                        "genesis_time": str(st.genesis_time),
+                        "genesis_validators_root": "0x"
+                        + bytes(st.genesis_validators_root).hex(),
+                        "genesis_fork_version": "0x" + bytes(st.fork.current_version).hex(),
+                    }
+                ),
+            )
+        elif len(parts) == 6 and parts[:4] == ["eth", "v1", "beacon", "states"]:
+            state = self._state_for(parts[4])
+            if parts[5] == "finality_checkpoints":
+                cp = lambda c: {"epoch": str(c.epoch), "root": "0x" + bytes(c.root).hex()}
+                self._send(
+                    200,
+                    _data(
+                        {
+                            "previous_justified": cp(state.previous_justified_checkpoint),
+                            "current_justified": cp(state.current_justified_checkpoint),
+                            "finalized": cp(state.finalized_checkpoint),
+                        }
+                    ),
+                )
+            elif parts[5] == "root":
+                self._send(
+                    200,
+                    _data({"root": "0x" + t.BeaconState.hash_tree_root(state).hex()}),
+                )
+            else:
+                raise ApiError(404, "unknown state endpoint")
+        elif len(parts) == 5 and parts[:4] == ["eth", "v1", "beacon", "headers"]:
+            block_id = parts[4]
+            root = chain.head_root if block_id == "head" else bytes.fromhex(block_id[2:])
+            signed = chain.store.get_block(root)
+            if signed is None and root != chain.genesis_block_root:
+                raise ApiError(404, "block not found")
+            if signed is None:
+                state = chain.store.get_state(chain.genesis_block_root)
+                header = state.latest_block_header
+            else:
+                b = signed.message
+                header = BeaconBlockHeader(
+                    slot=b.slot,
+                    proposer_index=b.proposer_index,
+                    parent_root=b.parent_root,
+                    state_root=b.state_root,
+                    body_root=t.BeaconBlockBody.hash_tree_root(b.body),
+                )
+            self._send(
+                200,
+                _data(
+                    {
+                        "root": "0x" + root.hex(),
+                        "canonical": True,
+                        "header": {"message": encode(header, BeaconBlockHeader)},
+                    }
+                ),
+            )
+        elif len(parts) == 6 and parts[:5] == ["eth", "v1", "validator", "duties", "proposer"]:
+            epoch = int(parts[5])
+            duties = api.proposer_duties(epoch)
+            state = chain.head_state()
+            self._send(
+                200,
+                _data(
+                    [
+                        {
+                            "pubkey": "0x" + bytes(state.validators[vi].pubkey).hex(),
+                            "validator_index": str(vi),
+                            "slot": str(slot),
+                        }
+                        for slot, vi in sorted(duties.items())
+                    ]
+                ),
+            )
+        elif parts == ["eth", "v1", "validator", "attestation_data"]:
+            slot = int(q["slot"][0])
+            ci = int(q["committee_index"][0])
+            data = api.attestation_data(slot, ci)
+            self._send(200, _data(encode(data, type(data))))
+        elif len(parts) == 5 and parts[:4] == ["eth", "v2", "validator", "blocks"]:
+            slot = int(parts[4])
+            reveal = bytes.fromhex(q["randao_reveal"][0].removeprefix("0x"))
+            block = api.produce_block(slot, reveal)
+            self._send(
+                200,
+                json.dumps({"version": "phase0", "data": encode(block, t.BeaconBlock)}).encode(),
+            )
+        else:
+            raise ApiError(404, "unknown endpoint")
+
+    # -- POST --------------------------------------------------------------
+
+    def do_POST(self):
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            body = json.loads(self.rfile.read(length) or b"null")
+            parts = [p for p in urlparse(self.path).path.split("/") if p]
+            self._route_post(parts, body)
+        except ApiError as e:
+            self._error(e.status, str(e))
+        except (StateTransitionError, BlockError) as e:
+            self._error(400, str(e))
+        except Exception as e:  # noqa: BLE001
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def _route_post(self, parts, body):
+        api, ctx = self.api, self.chain.ctx
+        t = ctx.types
+        if parts == ["eth", "v1", "beacon", "pool", "attestations"]:
+            failures = []
+            for i, obj in enumerate(body):
+                att = decode(obj, t.Attestation)
+                if not api.publish_attestation(att):
+                    failures.append({"index": i, "message": "attestation rejected"})
+            if failures:
+                self._send(
+                    400,
+                    json.dumps(
+                        {"code": 400, "message": "some attestations failed", "failures": failures}
+                    ).encode(),
+                )
+            else:
+                self._send(200, b"{}")
+        elif parts == ["eth", "v1", "beacon", "blocks"]:
+            signed = decode(body, t.SignedBeaconBlock)
+            root = api.publish_block(signed)
+            self._send(200, json.dumps({"data": {"root": "0x" + root.hex()}}).encode())
+        elif len(parts) == 6 and parts[:5] == ["eth", "v1", "validator", "duties", "attester"]:
+            epoch = int(parts[5])
+            indices = [int(i) for i in body]
+            state = self.chain.head_state()
+            pubkeys = [
+                bytes(state.validators[i].pubkey) for i in indices if i < len(state.validators)
+            ]
+            duties = api.attester_duties(epoch, pubkeys)
+            self._send(
+                200,
+                _data(
+                    [
+                        {
+                            "pubkey": "0x"
+                            + bytes(state.validators[d.validator_index].pubkey).hex(),
+                            "validator_index": str(d.validator_index),
+                            "committee_index": str(d.committee_index),
+                            "committee_length": str(d.committee_length),
+                            "validator_committee_index": str(d.committee_position),
+                            "slot": str(d.slot),
+                        }
+                        for d in duties
+                    ]
+                ),
+            )
+        else:
+            raise ApiError(404, "unknown endpoint")
+
+
+class HttpApiServer:
+    """Owns the listening socket + serving thread."""
+
+    def __init__(self, api, host: str = "127.0.0.1", port: int = 0):
+        handler = type("BoundHandler", (_Handler,), {"api": api, "chain": api.chain})
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def start(self) -> "HttpApiServer":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()

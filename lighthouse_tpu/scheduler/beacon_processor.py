@@ -95,6 +95,9 @@ class BeaconProcessor:
                 return False
         q.append(item)
         self.stats.bump(self.stats.submitted, work_type)
+        from ..common.metrics import PROCESSOR_QUEUE_DEPTH
+
+        PROCESSOR_QUEUE_DEPTH.set(len(self))
         return True
 
     def __len__(self) -> int:
@@ -119,6 +122,9 @@ class BeaconProcessor:
             else:
                 items = [q.popleft()]
             self.stats.bump(self.stats.drained, wt, len(items))
+            from ..common.metrics import PROCESSOR_QUEUE_DEPTH
+
+            PROCESSOR_QUEUE_DEPTH.set(len(self))
             return Batch(work_type=wt, items=items)
         return None
 
@@ -126,14 +132,14 @@ class BeaconProcessor:
         """Drain by priority through `handlers[work_type](items)`; returns
         the number of batches processed. The synchronous in-process stand-in
         for the reference's manager-task + blocking-worker-pool loop."""
+        missing = [wt for wt, q in self.queues.items() if q and wt not in handlers]
+        if missing:
+            raise KeyError(f"no handler for queued work types {missing!r}")
         n = 0
         while max_batches is None or n < max_batches:
             batch = self.next_batch()
             if batch is None:
                 break
-            handler = handlers.get(batch.work_type)
-            if handler is None:
-                raise KeyError(f"no handler for {batch.work_type!r}")
-            handler(batch.items)
+            handlers[batch.work_type](batch.items)
             n += 1
         return n

@@ -56,9 +56,32 @@ class MerkleTree:
         return path
 
     def push(self, leaf: bytes) -> None:
-        """Append a leaf (deposit-tree style) and update the path."""
+        """Append a leaf (deposit-tree style), updating only the O(depth)
+        branch path — the canonical incremental deposit-tree insert."""
+        index = len(self.leaves)
+        if index >= (1 << self.depth):
+            raise ValueError("tree is full")
         self.leaves.append(bytes(leaf))
-        self.__init__(self.leaves, self.depth)  # simple rebuild; O(n) amortized fine here
+        node = bytes(leaf)
+        for d in range(self.depth):
+            level = self._levels[d]
+            if index < len(level):
+                level[index] = node
+            else:
+                level.append(node)
+            sibling_index = index ^ 1
+            if index & 1:
+                sibling = level[sibling_index]
+                node = _h(sibling, node)
+            else:
+                sibling = level[sibling_index] if sibling_index < len(level) else ZERO_HASHES[d]
+                node = _h(node, sibling)
+            index >>= 1
+        top = self._levels[self.depth]
+        if index < len(top):
+            top[index] = node
+        else:
+            top.append(node)
 
 
 def verify_merkle_proof(leaf: bytes, proof: list[bytes], depth: int, index: int, root: bytes) -> bool:

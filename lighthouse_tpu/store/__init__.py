@@ -5,6 +5,7 @@ MemoryStore here plays the role of memory_store.rs for the in-process
 harness; a hot/cold split can slot in behind the same Store interface.
 """
 
+from .hot_cold import HotColdDB
 from .memory import MemoryStore, Store
 
-__all__ = ["MemoryStore", "Store"]
+__all__ = ["HotColdDB", "MemoryStore", "Store"]

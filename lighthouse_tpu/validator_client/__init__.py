@@ -1,0 +1,20 @@
+"""Validator client (SURVEY.md §2.4): duties, slashing-protected signing,
+attestation/block services over the beacon-node API seam.
+"""
+
+from .slashing_protection import SlashingDatabase, SlashingProtectionError
+from .validator_client import (
+    AttesterDuty,
+    BeaconNodeApi,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+__all__ = [
+    "SlashingDatabase",
+    "SlashingProtectionError",
+    "AttesterDuty",
+    "BeaconNodeApi",
+    "ValidatorClient",
+    "ValidatorStore",
+]

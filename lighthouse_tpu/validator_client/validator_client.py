@@ -1,0 +1,262 @@
+"""Validator client: duties, signing, publishing.
+
+Counterpart of /root/reference/validator_client/src (lib.rs:81
+ProductionValidatorClient, duties_service.rs, attestation_service.rs,
+block_service.rs), restructured in-process: the `BeaconNodeApi` seam plays
+the role of the eth2 HTTP client — the duty/production/publish surface is
+the same, so an HTTP transport can slot in behind it without touching the
+services.
+
+Every signature passes through the ValidatorStore, which consults the
+EIP-3076 slashing database before releasing a signature
+(signing_method.rs + slashing_database.rs one-txn-per-signing).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..chain.attestation_processing import batch_verify_gossip_attestations
+from ..op_pool import OperationPool
+from ..ssz.types import uint64
+from ..state_transition.helpers import (
+    get_beacon_committee,
+    get_beacon_proposer_index,
+    get_committee_count_per_slot,
+    get_current_epoch,
+)
+from ..types import (
+    compute_epoch_at_slot,
+    compute_signing_root,
+    compute_start_slot_at_epoch,
+    get_domain,
+)
+from ..types.containers import Checkpoint, SigningData
+from .slashing_protection import SlashingDatabase, SlashingProtectionError
+
+
+@dataclass
+class AttesterDuty:
+    validator_index: int
+    slot: int
+    committee_index: int
+    committee_position: int
+    committee_length: int
+
+
+class ValidatorStore:
+    """Keys + slashing-protected signing (validator_store.rs)."""
+
+    def __init__(self, ctx, slashing_db: SlashingDatabase | None = None):
+        self.ctx = ctx
+        self.keys = {}  # pubkey bytes -> SecretKey
+        self.slashing_db = slashing_db or SlashingDatabase()
+
+    def add_validator(self, secret_key) -> bytes:
+        pk = secret_key.public_key().to_bytes()
+        self.keys[pk] = secret_key
+        self.slashing_db.register_validator(pk)
+        return pk
+
+    def pubkeys(self) -> list[bytes]:
+        return list(self.keys)
+
+    def sign_block(self, pubkey: bytes, block, state):
+        ctx = self.ctx
+        domain = get_domain(
+            state, ctx.spec.domain_beacon_proposer,
+            compute_epoch_at_slot(block.slot, ctx.preset), ctx.preset,
+        )
+        root = compute_signing_root(block, domain)
+        self.slashing_db.check_and_insert_block_proposal(pubkey, block.slot, root)
+        return self.keys[pubkey].sign(root).to_bytes()
+
+    def sign_attestation(self, pubkey: bytes, data, state) -> bytes:
+        ctx = self.ctx
+        domain = get_domain(
+            state, ctx.spec.domain_beacon_attester, data.target.epoch, ctx.preset
+        )
+        root = compute_signing_root(data, domain)
+        self.slashing_db.check_and_insert_attestation(
+            pubkey, data.source.epoch, data.target.epoch, root
+        )
+        return self.keys[pubkey].sign(root).to_bytes()
+
+    def sign_randao(self, pubkey: bytes, epoch: int, state) -> bytes:
+        ctx = self.ctx
+        domain = get_domain(state, ctx.spec.domain_randao, epoch, ctx.preset)
+        sd = SigningData(object_root=uint64.hash_tree_root(epoch), domain=domain)
+        return self.keys[pubkey].sign(SigningData.hash_tree_root(sd)).to_bytes()
+
+
+class BeaconNodeApi:
+    """In-process beacon-node surface (the role of common/eth2's
+    BeaconNodeHttpClient + beacon_node/http_api endpoints the VC uses)."""
+
+    def __init__(self, chain, op_pool: OperationPool | None = None):
+        self.chain = chain
+        self.op_pool = op_pool or OperationPool(chain.ctx)
+
+    # duties (http_api validator/duties/{attester,proposer})
+    def attester_duties(self, epoch: int, pubkeys: list[bytes]) -> list[AttesterDuty]:
+        ctx = self.chain.ctx
+        state = self.chain.head_state().copy()
+        start = compute_start_slot_at_epoch(epoch, ctx.preset)
+        if state.slot < start:
+            from ..state_transition import process_slots
+
+            process_slots(state, start, ctx)
+        index_by_pk = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+        wanted = {index_by_pk[pk] for pk in pubkeys if pk in index_by_pk}
+        duties = []
+        for slot in range(start, start + ctx.preset.slots_per_epoch):
+            n = get_committee_count_per_slot(state, epoch, ctx.preset)
+            for ci in range(n):
+                committee = get_beacon_committee(state, slot, ci, ctx.preset, ctx.spec)
+                for pos, vi in enumerate(committee):
+                    if vi in wanted:
+                        duties.append(
+                            AttesterDuty(
+                                validator_index=vi,
+                                slot=slot,
+                                committee_index=ci,
+                                committee_position=pos,
+                                committee_length=len(committee),
+                            )
+                        )
+        return duties
+
+    def proposer_duties(self, epoch: int) -> dict[int, int]:
+        """slot -> proposer validator index for the epoch."""
+        ctx = self.chain.ctx
+        state = self.chain.head_state().copy()
+        start = compute_start_slot_at_epoch(epoch, ctx.preset)
+        from ..state_transition import process_slots
+
+        out = {}
+        for slot in range(start, start + ctx.preset.slots_per_epoch):
+            s = state.copy()
+            if s.slot < slot:
+                process_slots(s, slot, ctx)
+            out[slot] = get_beacon_proposer_index(s, ctx.preset, ctx.spec)
+        return out
+
+    # attestation production/publish (validator/attestation_data + POST)
+    def attestation_data(self, slot: int, committee_index: int):
+        ctx = self.chain.ctx
+        head_root = self.chain.head_root
+        state = self.chain.state_at_slot(slot)
+        epoch = compute_epoch_at_slot(slot, ctx.preset)
+        start_slot = compute_start_slot_at_epoch(epoch, ctx.preset)
+        if start_slot == slot or state.slot <= start_slot:
+            target_root = head_root
+        else:
+            target_root = state.block_roots[start_slot % ctx.preset.slots_per_historical_root]
+        return ctx.types.AttestationData(
+            slot=slot,
+            index=committee_index,
+            beacon_block_root=head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=epoch, root=target_root),
+        )
+
+    def publish_attestation(self, attestation) -> bool:
+        results = batch_verify_gossip_attestations(self.chain, [attestation])
+        ok = results[0] is True
+        if ok:
+            self.op_pool.insert_attestation(attestation)
+        return ok
+
+    # block production/publish (validator/blocks + POST)
+    def produce_block(self, slot: int, randao_reveal: bytes):
+        chain = self.chain
+        state = chain.state_at_slot(slot)
+        atts = self.op_pool.get_attestations(state)
+        proposer, attester, exits = self.op_pool.get_slashings_and_exits(state)
+        block, _ = chain.produce_block_on_state(
+            state,
+            slot,
+            randao_reveal,
+            attestations=atts,
+            proposer_slashings=proposer,
+            attester_slashings=attester,
+            exits=exits,
+        )
+        return block
+
+    def publish_block(self, signed_block) -> bytes:
+        self.chain.slot_clock.set_slot(max(self.chain.slot(), signed_block.message.slot))
+        root = self.chain.process_block(signed_block)
+        self.op_pool.prune(self.chain.store.get_state(root))
+        return root
+
+
+class ValidatorClient:
+    """Drives duties for its validators each slot (the per-slot work of
+    duties_service + attestation_service + block_service)."""
+
+    def __init__(self, api: BeaconNodeApi, store: ValidatorStore):
+        self.api = api
+        self.store = store
+        self.ctx = store.ctx
+        self._duty_cache: dict[int, list[AttesterDuty]] = {}
+
+    def _duties_for_epoch(self, epoch: int) -> list[AttesterDuty]:
+        if epoch not in self._duty_cache:
+            self._duty_cache[epoch] = self.api.attester_duties(epoch, self.store.pubkeys())
+            # keep the cache bounded
+            for e in [e for e in self._duty_cache if e + 2 < epoch]:
+                del self._duty_cache[e]
+        return self._duty_cache[epoch]
+
+    def on_slot(self, slot: int) -> dict:
+        """Run this slot's duties: propose if due, then attest. Returns a
+        summary {proposed: root|None, attested: n}."""
+        ctx = self.ctx
+        epoch = compute_epoch_at_slot(slot, ctx.preset)
+        summary = {"proposed": None, "attested": 0}
+
+        # -- block duty (block_service.rs) --
+        proposers = self.api.proposer_duties(epoch)
+        proposer_index = proposers.get(slot)
+        state = self.api.chain.head_state()
+        if proposer_index is not None and proposer_index < len(state.validators):
+            pk = bytes(state.validators[proposer_index].pubkey)
+            if pk in self.store.keys:
+                reveal = self.store.sign_randao(pk, epoch, state)
+                block = self.api.produce_block(slot, reveal)
+                sig = self.store.sign_block(pk, block, state)
+                signed = ctx.types.SignedBeaconBlock(message=block, signature=sig)
+                summary["proposed"] = self.api.publish_block(signed)
+
+        # -- attestation duties at slot (attestation_service.rs:125) --
+        head_state = self.api.chain.head_state()
+        index_by_pk = {bytes(v.pubkey): i for i, v in enumerate(head_state.validators)}
+        by_committee: dict[int, list[AttesterDuty]] = {}
+        for duty in self._duties_for_epoch(epoch):
+            if duty.slot == slot:
+                by_committee.setdefault(duty.committee_index, []).append(duty)
+        for ci, duties in sorted(by_committee.items()):
+            data = self.api.attestation_data(slot, ci)
+            for duty in duties:
+                pk = next(
+                    (
+                        pk
+                        for pk, vi in index_by_pk.items()
+                        if vi == duty.validator_index and pk in self.store.keys
+                    ),
+                    None,
+                )
+                if pk is None:
+                    continue
+                try:
+                    sig = self.store.sign_attestation(pk, data, head_state)
+                except SlashingProtectionError:
+                    continue
+                bits = [i == duty.committee_position for i in range(duty.committee_length)]
+                att = ctx.types.Attestation(
+                    aggregation_bits=bits, data=data, signature=sig
+                )
+                if self.api.publish_attestation(att):
+                    summary["attested"] += 1
+        return summary

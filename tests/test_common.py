@@ -110,3 +110,13 @@ def test_push_updates_root():
     t.push(b"\x02" * 32)
     assert t.root != r1
     assert verify_merkle_proof(b"\x02" * 32, t.proof(1), 3, 1, t.root)
+
+
+def test_incremental_push_matches_rebuild():
+    leaves = [bytes([i]) * 32 for i in range(9)]
+    inc = MerkleTree([], depth=5)
+    for i, leaf in enumerate(leaves):
+        inc.push(leaf)
+        rebuilt = MerkleTree(leaves[: i + 1], depth=5)
+        assert inc.root == rebuilt.root
+        assert inc.proof(i) == rebuilt.proof(i)

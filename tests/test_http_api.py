@@ -1,0 +1,130 @@
+"""HTTP API tests: a real server on localhost, driven by urllib — the
+validator-client path over the wire (duties -> attestation data -> publish;
+produce block -> sign -> publish)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChain
+from lighthouse_tpu.http_api import HttpApiServer, decode, encode
+from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
+from lighthouse_tpu.types import compute_signing_root, get_domain
+from lighthouse_tpu.validator_client import BeaconNodeApi
+
+
+@pytest.fixture(scope="module")
+def server():
+    ctx = TransitionContext.minimal("fake")
+    genesis = interop_genesis_state(16, 1600000000, ctx)
+    chain = BeaconChain(genesis, ctx)
+    api = BeaconNodeApi(chain)
+    srv = HttpApiServer(api).start()
+    yield ctx, chain, srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}") as r:
+        body = r.read()
+        return r.status, json.loads(body) if body else None
+
+
+def _post(srv, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read() or b"null")
+
+
+def test_node_and_genesis_endpoints(server):
+    ctx, chain, srv = server
+    status, _ = _get(srv, "/eth/v1/node/health")
+    assert status == 200
+    _, version = _get(srv, "/eth/v1/node/version")
+    assert "lighthouse-tpu" in version["data"]["version"]
+    _, genesis = _get(srv, "/eth/v1/beacon/genesis")
+    assert genesis["data"]["genesis_time"] == "1600000000"
+    _, fin = _get(srv, "/eth/v1/beacon/states/head/finality_checkpoints")
+    assert fin["data"]["finalized"]["epoch"] == "0"
+    _, hdr = _get(srv, "/eth/v1/beacon/headers/head")
+    assert hdr["data"]["root"] == "0x" + chain.genesis_block_root.hex()
+
+
+def test_metrics_endpoint(server):
+    _, chain, srv = server
+    with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics") as r:
+        text = r.read().decode()
+    assert "# TYPE lighthouse_tpu_bls_batch_verify_seconds histogram" in text
+
+
+def test_full_vc_flow_over_http(server):
+    ctx, chain, srv = server
+    t = ctx.types
+
+    # proposer duties for epoch 0
+    _, duties = _get(srv, "/eth/v1/validator/duties/proposer/0")
+    by_slot = {int(d["slot"]): int(d["validator_index"]) for d in duties["data"]}
+    proposer = by_slot[1]
+
+    # produce a block at slot 1 over HTTP
+    sk, _ = ctx.bls.interop_keypair(proposer)
+    state = chain.head_state()
+    from lighthouse_tpu.ssz.types import uint64
+    from lighthouse_tpu.types.containers import SigningData
+
+    domain = get_domain(state, ctx.spec.domain_randao, 0, ctx.preset)
+    sd = SigningData(object_root=uint64.hash_tree_root(0), domain=domain)
+    reveal = sk.sign(SigningData.hash_tree_root(sd)).to_bytes()
+    status, blk = _get(srv, f"/eth/v2/validator/blocks/1?randao_reveal=0x{reveal.hex()}")
+    assert status == 200 and blk["version"] == "phase0"
+    block = decode(blk["data"], t.BeaconBlock)
+    assert block.slot == 1
+
+    # sign + publish over HTTP
+    domain = get_domain(state, ctx.spec.domain_beacon_proposer, 0, ctx.preset)
+    sig = sk.sign(compute_signing_root(block, domain)).to_bytes()
+    signed = t.SignedBeaconBlock(message=block, signature=sig)
+    status, resp = _post(srv, "/eth/v1/beacon/blocks", encode(signed, t.SignedBeaconBlock))
+    assert status == 200
+    head_root = bytes.fromhex(resp["data"]["root"][2:])
+    assert chain.head_root == head_root
+
+    # attester duties + attestation data + publish
+    status, att_duties = _post(srv, "/eth/v1/validator/duties/attester/0", list(range(16)))
+    assert status == 200
+    duty = next(d for d in att_duties["data"] if int(d["slot"]) == 1)
+    _, ad = _get(
+        srv,
+        f"/eth/v1/validator/attestation_data?slot=1&committee_index={duty['committee_index']}",
+    )
+    data = decode(ad["data"], t.AttestationData)
+    assert bytes(data.beacon_block_root) == head_root
+    vsk, _ = ctx.bls.interop_keypair(int(duty["validator_index"]))
+    domain = get_domain(state, ctx.spec.domain_beacon_attester, data.target.epoch, ctx.preset)
+    asig = vsk.sign(compute_signing_root(data, domain)).to_bytes()
+    att = t.Attestation(
+        aggregation_bits=[
+            i == int(duty["validator_committee_index"])
+            for i in range(int(duty["committee_length"]))
+        ],
+        data=data,
+        signature=asig,
+    )
+    status, _ = _post(srv, "/eth/v1/beacon/pool/attestations", [encode(att, t.Attestation)])
+    assert status == 200
+
+
+def test_error_shapes(server):
+    ctx, chain, srv = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/eth/v1/nonexistent")
+    assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/eth/v1/beacon/headers/0x" + "ab" * 32)
+    assert e.value.code == 404

@@ -1,0 +1,126 @@
+"""Validator client + slashing protection.
+
+The end-to-end test drives a chain for 3+ epochs purely through the
+validator-client duty loop (produce -> sign via slashing DB -> publish) on
+the fake backend and checks justification/finality — the VC-side mirror of
+the harness finality test.
+"""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChain
+from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
+from lighthouse_tpu.types import MINIMAL_PRESET
+from lighthouse_tpu.validator_client import (
+    BeaconNodeApi,
+    SlashingDatabase,
+    SlashingProtectionError,
+    ValidatorClient,
+    ValidatorStore,
+)
+
+
+# -- slashing protection unit tests --------------------------------------------
+
+
+def test_block_double_proposal_blocked():
+    db = SlashingDatabase()
+    db.register_validator(b"\x01" * 48)
+    db.check_and_insert_block_proposal(b"\x01" * 48, 5, b"\xaa" * 32)
+    # identical re-sign ok
+    db.check_and_insert_block_proposal(b"\x01" * 48, 5, b"\xaa" * 32)
+    with pytest.raises(SlashingProtectionError, match="double block"):
+        db.check_and_insert_block_proposal(b"\x01" * 48, 5, b"\xbb" * 32)
+    with pytest.raises(SlashingProtectionError, match="below minimum"):
+        db.check_and_insert_block_proposal(b"\x01" * 48, 4, b"\xcc" * 32)
+
+
+def test_attestation_double_vote_blocked():
+    db = SlashingDatabase()
+    pk = b"\x02" * 48
+    db.register_validator(pk)
+    db.check_and_insert_attestation(pk, 0, 1, b"\xaa" * 32)
+    db.check_and_insert_attestation(pk, 0, 1, b"\xaa" * 32)  # same root ok
+    with pytest.raises(SlashingProtectionError, match="double vote"):
+        db.check_and_insert_attestation(pk, 0, 1, b"\xbb" * 32)
+
+
+def test_attestation_surround_blocked():
+    db = SlashingDatabase()
+    pk = b"\x03" * 48
+    db.register_validator(pk)
+    db.check_and_insert_attestation(pk, 2, 3, b"\xaa" * 32)
+    with pytest.raises(SlashingProtectionError, match="surround"):
+        db.check_and_insert_attestation(pk, 1, 4, b"\xbb" * 32)  # surrounds (2,3)
+    db2 = SlashingDatabase()
+    db2.register_validator(pk)
+    db2.check_and_insert_attestation(pk, 1, 4, b"\xaa" * 32)
+    with pytest.raises(SlashingProtectionError, match="surrounded"):
+        db2.check_and_insert_attestation(pk, 2, 3, b"\xbb" * 32)  # surrounded by (1,4)
+
+
+def test_unregistered_validator_refused():
+    db = SlashingDatabase()
+    with pytest.raises(SlashingProtectionError, match="unregistered"):
+        db.check_and_insert_block_proposal(b"\x09" * 48, 1, b"\x00" * 32)
+
+
+def test_interchange_roundtrip():
+    db = SlashingDatabase()
+    pk = b"\x04" * 48
+    db.register_validator(pk)
+    db.check_and_insert_block_proposal(pk, 7, b"\xaa" * 32)
+    db.check_and_insert_attestation(pk, 1, 2, b"\xbb" * 32)
+    dump = db.export_interchange(b"\x00" * 32)
+    assert dump["metadata"]["interchange_format_version"] == "5"
+
+    db2 = SlashingDatabase()
+    db2.import_interchange(dump)
+    # imported history still protects
+    with pytest.raises(SlashingProtectionError):
+        db2.check_and_insert_block_proposal(pk, 7, b"\xcc" * 32)
+    with pytest.raises(SlashingProtectionError):
+        db2.check_and_insert_attestation(pk, 1, 2, b"\xdd" * 32)
+
+
+# -- validator client end-to-end -----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def vc_setup():
+    ctx = TransitionContext.minimal("fake")
+    n = 16
+    genesis = interop_genesis_state(n, 1600000000, ctx)
+    chain = BeaconChain(genesis, ctx)
+    api = BeaconNodeApi(chain)
+    store = ValidatorStore(ctx)
+    for i in range(n):
+        sk, _ = ctx.bls.interop_keypair(i)
+        store.add_validator(sk)
+    return ctx, chain, ValidatorClient(api, store)
+
+
+def test_duties_cover_all_validators(vc_setup):
+    ctx, chain, vc = vc_setup
+    duties = vc.api.attester_duties(0, vc.store.pubkeys())
+    assert {d.validator_index for d in duties} == set(range(16))
+    # every duty is inside the epoch
+    assert all(0 <= d.slot < MINIMAL_PRESET.slots_per_epoch for d in duties)
+    proposers = vc.api.proposer_duties(0)
+    assert set(proposers) == set(range(MINIMAL_PRESET.slots_per_epoch))
+
+
+def test_vc_drives_chain_to_finality(vc_setup):
+    ctx, chain, vc = vc_setup
+    spe = MINIMAL_PRESET.slots_per_epoch
+    for slot in range(1, 4 * spe + 1):
+        summary = vc.on_slot(slot)
+        assert summary["proposed"] is not None, f"no block at slot {slot}"
+        assert summary["attested"] > 0
+    state = chain.head_state()
+    assert state.current_justified_checkpoint.epoch >= 2
+    assert state.finalized_checkpoint.epoch >= 1
+    # the slashing DB now refuses re-signing any of those duties
+    pk = vc.store.pubkeys()[0]
+    with pytest.raises(SlashingProtectionError):
+        vc.store.slashing_db.check_and_insert_attestation(pk, 0, 1, b"\xff" * 32)
