@@ -1,0 +1,199 @@
+"""Root CLI: `python -m lighthouse_tpu {beacon-node, validator-client,
+account-manager, lcli}`.
+
+Counterpart of /root/reference/lighthouse/src/main.rs:274-277 (the four
+subcommands), account_manager/, and the lcli dev tools (lcli/src/main.rs:
+54-603: interop-genesis, pretty-ssz, skip-slots).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _add_common(p: argparse.ArgumentParser) -> None:
+    p.add_argument("--preset", choices=["minimal", "mainnet"], default="minimal")
+    p.add_argument("--bls-backend", choices=["ref", "fake", "jax"], default="ref")
+
+
+def cmd_beacon_node(args) -> int:
+    from .client import Client, ClientConfig
+
+    cfg = ClientConfig(
+        preset=args.preset,
+        bls_backend=args.bls_backend,
+        datadir=args.datadir,
+        http_port=args.http_port,
+        slasher_enabled=args.slasher,
+        interop_validators=args.interop_validators,
+        genesis_time=args.genesis_time or int(time.time()),
+    )
+    client = Client(cfg)
+    print(f"beacon node up: preset={args.preset} bls={args.bls_backend}")
+    print(f"genesis root 0x{client.chain.genesis_block_root.hex()}")
+    if client.http:
+        print(f"http api listening on 127.0.0.1:{client.http.port}")
+    if args.run_slots is not None:
+        clock = client.chain.slot_clock
+        for slot in range(1, args.run_slots + 1):
+            clock.set_slot(slot)
+            client.per_slot_task(slot)
+        print(f"ran {args.run_slots} slots; head slot {client.chain.head_state().slot}")
+        client.shutdown()
+        return 0
+    try:
+        spe = client.ctx.spec.seconds_per_slot
+        while True:
+            time.sleep(spe)
+            slot = client.chain.slot() + 1
+            client.per_slot_task(slot)
+    except KeyboardInterrupt:
+        client.shutdown()
+    return 0
+
+
+def cmd_validator_client(args) -> int:
+    import urllib.request
+
+    # Keystore-based key loading (account-manager output) with an
+    # in-process fallback for interop keys.
+    from .crypto import keystore as ks
+    from .crypto import bls as bls_pkg
+
+    bls = bls_pkg.backend(args.bls_backend)
+    secret_keys = []
+    if args.keystores:
+        password = args.password or ""
+        for path in args.keystores:
+            secret_keys.append(bls.SecretKey.from_bytes(ks.decrypt(ks.load(path), password)))
+    else:
+        for i in range(args.interop_validators):
+            secret_keys.append(bls.interop_secret_key(i))
+    print(f"validator client: {len(secret_keys)} keys, beacon node {args.beacon_node}")
+    with urllib.request.urlopen(f"{args.beacon_node}/eth/v1/beacon/genesis") as r:
+        genesis = json.load(r)["data"]
+    print(f"connected; genesis time {genesis['genesis_time']}")
+    return 0
+
+
+def cmd_account_manager(args) -> int:
+    from .crypto import keystore as ks
+    from .crypto.wallet import Wallet
+
+    if args.account_cmd == "wallet-create":
+        w = Wallet.create(args.name, args.password)
+        with open(args.output, "w") as f:
+            json.dump(w.data, f, indent=2)
+        print(f"wallet {args.name} written to {args.output}")
+        return 0
+    if args.account_cmd == "validator-create":
+        with open(args.wallet) as f:
+            w = Wallet({**json.load(f)})
+        store, index = w.next_validator(args.password, args.keystore_password)
+        out = args.output or f"validator_{index}.json"
+        ks.save(store, out)
+        with open(args.wallet, "w") as f:
+            json.dump(w.data, f, indent=2)
+        print(f"validator {index} keystore written to {out} (path {store['path']})")
+        return 0
+    raise SystemExit(f"unknown account-manager command {args.account_cmd}")
+
+
+def cmd_lcli(args) -> int:
+    from .state_transition import TransitionContext, interop_genesis_state, process_slots
+
+    ctx = (
+        TransitionContext.minimal(args.bls_backend)
+        if args.preset == "minimal"
+        else TransitionContext.mainnet(args.bls_backend)
+    )
+    if args.lcli_cmd == "interop-genesis":
+        state = interop_genesis_state(args.validators, args.genesis_time, ctx)
+        data = ctx.types.BeaconState.serialize(state)
+        with open(args.output, "wb") as f:
+            f.write(data)
+        root = ctx.types.BeaconState.hash_tree_root(state)
+        print(f"genesis state ({len(data)} bytes) -> {args.output}; root 0x{root.hex()}")
+        return 0
+    if args.lcli_cmd == "skip-slots":
+        with open(args.state, "rb") as f:
+            state = ctx.types.BeaconState.deserialize(f.read())
+        process_slots(state, state.slot + args.slots, ctx)
+        with open(args.output, "wb") as f:
+            f.write(ctx.types.BeaconState.serialize(state))
+        print(f"advanced to slot {state.slot} -> {args.output}")
+        return 0
+    if args.lcli_cmd == "pretty-ssz":
+        from .http_api.json_codec import encode
+
+        td = getattr(ctx.types, args.type)
+        with open(args.file, "rb") as f:
+            value = td.deserialize(f.read())
+        print(json.dumps(encode(value, td), indent=2))
+        return 0
+    raise SystemExit(f"unknown lcli command {args.lcli_cmd}")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    root = argparse.ArgumentParser(prog="lighthouse_tpu")
+    sub = root.add_subparsers(dest="command", required=True)
+
+    bn = sub.add_parser("beacon-node", help="run a beacon node")
+    _add_common(bn)
+    bn.add_argument("--datadir")
+    bn.add_argument("--http-port", type=int, default=5052)
+    bn.add_argument("--slasher", action="store_true")
+    bn.add_argument("--interop-validators", type=int, default=16)
+    bn.add_argument("--genesis-time", type=int)
+    bn.add_argument("--run-slots", type=int, help="run N slots then exit (testing)")
+    bn.set_defaults(fn=cmd_beacon_node)
+
+    vc = sub.add_parser("validator-client", help="run a validator client")
+    _add_common(vc)
+    vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument("--keystores", nargs="*")
+    vc.add_argument("--password")
+    vc.add_argument("--interop-validators", type=int, default=0)
+    vc.set_defaults(fn=cmd_validator_client)
+
+    am = sub.add_parser("account-manager", help="wallet and validator keys")
+    am_sub = am.add_subparsers(dest="account_cmd", required=True)
+    wc = am_sub.add_parser("wallet-create")
+    wc.add_argument("--name", required=True)
+    wc.add_argument("--password", required=True)
+    wc.add_argument("--output", required=True)
+    vcr = am_sub.add_parser("validator-create")
+    vcr.add_argument("--wallet", required=True)
+    vcr.add_argument("--password", required=True)
+    vcr.add_argument("--keystore-password", required=True)
+    vcr.add_argument("--output")
+    am.set_defaults(fn=cmd_account_manager)
+
+    lc = sub.add_parser("lcli", help="dev tools")
+    _add_common(lc)
+    lc_sub = lc.add_subparsers(dest="lcli_cmd", required=True)
+    ig = lc_sub.add_parser("interop-genesis")
+    ig.add_argument("--validators", type=int, default=16)
+    ig.add_argument("--genesis-time", type=int, default=1600000000)
+    ig.add_argument("--output", required=True)
+    sk = lc_sub.add_parser("skip-slots")
+    sk.add_argument("--state", required=True)
+    sk.add_argument("--slots", type=int, required=True)
+    sk.add_argument("--output", required=True)
+    ps = lc_sub.add_parser("pretty-ssz")
+    ps.add_argument("--type", required=True)
+    ps.add_argument("--file", required=True)
+    lc.set_defaults(fn=cmd_lcli)
+    return root
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

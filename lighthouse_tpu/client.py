@@ -1,0 +1,162 @@
+"""Client assembly: build a running node from config.
+
+Counterpart of /root/reference/beacon_node/client/src/builder.rs:58
+(ClientBuilder) + beacon_node/src: chains store -> genesis strategy ->
+beacon chain -> op pool -> work scheduler -> HTTP API -> (optional)
+slasher, then drives the per-slot timer (beacon_node/timer).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .chain import BeaconChain
+from .chain.slot_clock import ManualSlotClock, SystemSlotClock
+from .http_api import HttpApiServer
+from .op_pool import OperationPool
+from .scheduler import BeaconProcessor, WorkType
+from .chain.attestation_processing import batch_verify_gossip_attestations
+from .slasher import Slasher
+from .state_transition import TransitionContext, interop_genesis_state
+from .store import HotColdDB, MemoryStore
+from .validator_client import BeaconNodeApi
+
+
+@dataclass
+class ClientConfig:
+    preset: str = "minimal"
+    bls_backend: str = "ref"
+    datadir: str | None = None  # None = in-memory store
+    http_port: int = 0  # 0 = ephemeral
+    http_enabled: bool = True
+    slasher_enabled: bool = False
+    # genesis
+    interop_validators: int = 16
+    genesis_time: int = 1600000000
+    slots_per_restore_point: int = 32
+
+
+class Client:
+    """An assembled node: chain + pool + scheduler + API server."""
+
+    def __init__(self, config: ClientConfig):
+        self.config = config
+        ctx = (
+            TransitionContext.minimal(config.bls_backend)
+            if config.preset == "minimal"
+            else TransitionContext.mainnet(config.bls_backend)
+        )
+        self.ctx = ctx
+
+        if config.datadir:
+            store = HotColdDB(
+                ctx, path=config.datadir, slots_per_restore_point=config.slots_per_restore_point
+            )
+        else:
+            store = MemoryStore()
+
+        # genesis strategy (builder.rs:218-330): resume from store if it has
+        # a persisted head, else interop genesis
+        resumed = False
+        if isinstance(store, HotColdDB) and store.genesis_root is not None:
+            genesis_state = store.get_state(store.genesis_root)
+            resumed = genesis_state is not None
+        if not resumed:
+            genesis_state = interop_genesis_state(
+                config.interop_validators, config.genesis_time, ctx
+            )
+
+        self.chain = BeaconChain(genesis_state, ctx, store=store)
+        if resumed:
+            self._replay_fork_choice(store)
+        self.op_pool = OperationPool(ctx)
+        self.api = BeaconNodeApi(self.chain, op_pool=self.op_pool)
+        self.processor = BeaconProcessor()
+        self.slasher = Slasher(ctx) if config.slasher_enabled else None
+        self.http: HttpApiServer | None = None
+        if config.http_enabled:
+            self.http = HttpApiServer(self.api, port=config.http_port).start()
+
+    def _replay_fork_choice(self, store: HotColdDB) -> None:
+        """Rebuild fork choice from persisted blocks (ClientGenesis::FromStore)."""
+        for root, blk in sorted(
+            store.blocks.items(), key=lambda kv: store.block_slot[kv[0]]
+        ):
+            if not self.chain.fork_choice.contains_block(root):
+                state = store.get_state(root)
+                if state is None:
+                    continue
+                self.chain.fork_choice.on_tick(blk.message.slot)
+                self.chain.fork_choice.on_block(blk.message, root, state)
+        self.chain.recompute_head()
+
+    # -- gossip ingestion via the work scheduler -------------------------------
+
+    def submit_gossip_attestation(self, attestation) -> bool:
+        return self.processor.submit(WorkType.GOSSIP_ATTESTATION, attestation)
+
+    def submit_gossip_block(self, signed_block) -> bool:
+        return self.processor.submit(WorkType.GOSSIP_BLOCK, signed_block)
+
+    def process_pending(self) -> int:
+        """Drain the scheduler (the manager-loop turn)."""
+
+        def handle_attestations(items):
+            results = batch_verify_gossip_attestations(self.chain, items)
+            for att, ok in zip(items, results):
+                if ok is True:
+                    self.op_pool.insert_attestation(att)
+                    if self.slasher is not None:
+                        from .state_transition.helpers import get_indexed_attestation
+
+                        self.slasher.accept_attestation(
+                            get_indexed_attestation(
+                                self.chain.head_state(), att, self.ctx.types,
+                                self.ctx.preset, self.ctx.spec,
+                            )
+                        )
+
+        def handle_block(items):
+            for signed in items:
+                self.chain.process_block(signed)
+
+        return self.processor.drain(
+            {
+                WorkType.GOSSIP_ATTESTATION: handle_attestations,
+                WorkType.GOSSIP_BLOCK: handle_block,
+                WorkType.GOSSIP_AGGREGATE: handle_attestations,
+                WorkType.CHAIN_SEGMENT: handle_block,
+                WorkType.RPC_BLOCK: handle_block,
+                WorkType.DELAYED_BLOCK: handle_block,
+            }
+        )
+
+    # -- per-slot tick (beacon_node/timer) -------------------------------------
+
+    def per_slot_task(self, slot: int) -> None:
+        self.chain.slot_clock.set_slot(slot)
+        self.chain.fork_choice.on_tick(slot)
+        self.process_pending()
+        if self.slasher is not None:
+            from .types import compute_epoch_at_slot
+
+            atts, props = self.slasher.process_queued(
+                compute_epoch_at_slot(slot, self.ctx.preset)
+            )
+            for s in atts:
+                self.op_pool.insert_attester_slashing(s)
+            for s in props:
+                self.op_pool.insert_proposer_slashing(s)
+
+    def shutdown(self) -> None:
+        """Clean shutdown: persist chain head (Drop for BeaconChain,
+        beacon_chain.rs:4590), stop servers."""
+        store = self.chain.store
+        if isinstance(store, HotColdDB):
+            store.persist_head(self.chain.head_root, self.chain.genesis_block_root)
+            fin = self.chain.head_state().finalized_checkpoint
+            if bytes(fin.root) in store.blocks or bytes(fin.root) == self.chain.genesis_block_root:
+                if bytes(fin.root) != self.chain.genesis_block_root:
+                    store.migrate(bytes(fin.root))
+        if self.http is not None:
+            self.http.stop()

@@ -27,6 +27,22 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
+# ThreadingHTTPServer handles requests concurrently, but the chain, fork
+# choice, op pool, and container root memos are not thread-safe: one lock
+# serializes route execution (the reference serializes mutation through the
+# BeaconProcessor's single manager loop instead).
+_CHAIN_LOCK = threading.Lock()
+
+
+def _parse_root(hex_id: str, what: str) -> bytes:
+    try:
+        root = bytes.fromhex(hex_id.removeprefix("0x"))
+    except ValueError as e:
+        raise ApiError(400, f"invalid {what} id: {hex_id!r}") from e
+    if len(root) != 32:
+        raise ApiError(400, f"invalid {what} id length: {hex_id!r}")
+    return root
+
 from ..chain.beacon_chain import BlockError
 from ..common.metrics import REGISTRY
 from ..state_transition.helpers import StateTransitionError
@@ -82,7 +98,7 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ApiError(404, "state not found")
             return st
         if state_id.startswith("0x"):
-            st = chain.store.get_state(bytes.fromhex(state_id[2:]))
+            st = chain.store.get_state(_parse_root(state_id, "state"))
             if st is None:
                 raise ApiError(404, "state not found")
             return st
@@ -95,7 +111,8 @@ class _Handler(BaseHTTPRequestHandler):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
             q = parse_qs(url.query)
-            self._route_get(parts, q)
+            with _CHAIN_LOCK:
+                self._route_get(parts, q)
         except ApiError as e:
             self._error(e.status, str(e))
         except Exception as e:  # noqa: BLE001 - surface as 500, don't kill the server
@@ -158,13 +175,23 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ApiError(404, "unknown state endpoint")
         elif len(parts) == 5 and parts[:4] == ["eth", "v1", "beacon", "headers"]:
             block_id = parts[4]
-            root = chain.head_root if block_id == "head" else bytes.fromhex(block_id[2:])
+            root = chain.head_root if block_id == "head" else _parse_root(block_id, "block")
             signed = chain.store.get_block(root)
             if signed is None and root != chain.genesis_block_root:
                 raise ApiError(404, "block not found")
             if signed is None:
+                # genesis: rebuild the header with state_root filled so
+                # hash_tree_root(header) == the returned root (the same
+                # construction BeaconChain.__init__ uses)
                 state = chain.store.get_state(chain.genesis_block_root)
-                header = state.latest_block_header
+                lh = state.latest_block_header
+                header = BeaconBlockHeader(
+                    slot=lh.slot,
+                    proposer_index=lh.proposer_index,
+                    parent_root=lh.parent_root,
+                    state_root=t.BeaconState.hash_tree_root(state),
+                    body_root=lh.body_root,
+                )
             else:
                 b = signed.message
                 header = BeaconBlockHeader(
@@ -224,7 +251,8 @@ class _Handler(BaseHTTPRequestHandler):
             length = int(self.headers.get("Content-Length", "0"))
             body = json.loads(self.rfile.read(length) or b"null")
             parts = [p for p in urlparse(self.path).path.split("/") if p]
-            self._route_post(parts, body)
+            with _CHAIN_LOCK:
+                self._route_post(parts, body)
         except ApiError as e:
             self._error(e.status, str(e))
         except (StateTransitionError, BlockError) as e:

@@ -1,0 +1,25 @@
+"""Networking seam: gossip topics, an in-process gossip bus, per-node
+network services, and range sync.
+
+The reference's stack (SURVEY.md §2.3: lighthouse_network libp2p gossipsub
++ discv5 + Req/Resp, network/ router + sync) is an internet-facing host
+subsystem; its TPU-era role is unchanged (SURVEY §2.8 item 5 — ICI/DCN are
+for the verifier, not for talking to peers). This package provides the
+protocol-shaped seam and an in-process transport:
+
+  - `topics`: the gossip topic registry (types/topics.rs:11-28)
+  - `LocalNetwork`: a process-local gossip/req-resp hub — the transport the
+    reference's multi-node simulator runs over localhost sockets
+    (testing/simulator), collapsed to function calls
+  - `NetworkService`: per-node glue routing gossip into the node's
+    BeaconProcessor queues and serving BlocksByRange (network/src/router +
+    sync/range_sync)
+
+A real libp2p transport slots in behind the same publish/deliver surface.
+"""
+
+from .local import LocalNetwork
+from .service import NetworkService
+from .topics import Topic
+
+__all__ = ["LocalNetwork", "NetworkService", "Topic"]

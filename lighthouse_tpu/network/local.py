@@ -1,0 +1,37 @@
+"""Process-local gossip + req/resp hub.
+
+The transport role of libp2p gossipsub and the BlocksByRange RPC
+(lighthouse_network/src/rpc/protocol.rs:118-131) for multi-node-in-one-
+process testing — the reference's simulator runs N nodes over localhost
+sockets (testing/simulator/src/main.rs:1-16); this collapses the socket to
+a call, keeping the publish/subscribe/req-resp shape.
+"""
+
+from __future__ import annotations
+
+from .topics import Topic
+
+
+class LocalNetwork:
+    def __init__(self):
+        self.peers: dict[str, object] = {}  # node_id -> NetworkService
+
+    def register(self, node_id: str, service) -> None:
+        self.peers[node_id] = service
+
+    def publish(self, from_id: str, topic: Topic, message) -> None:
+        """Gossip: deliver to every peer except the publisher."""
+        for node_id, service in self.peers.items():
+            if node_id != from_id:
+                service.on_gossip(topic, message)
+
+    def blocks_by_range(self, requester_id: str, start_slot: int, count: int):
+        """Req/Resp BlocksByRange served by the first peer that can
+        (rpc/protocol.rs BlocksByRange; sync/range_sync)."""
+        for node_id, service in self.peers.items():
+            if node_id == requester_id:
+                continue
+            blocks = service.serve_blocks_by_range(start_slot, count)
+            if blocks:
+                return blocks
+        return []

@@ -1,0 +1,112 @@
+"""Per-node network service: gossip <-> BeaconProcessor <-> chain.
+
+The router/worker glue of /root/reference/beacon_node/network/src
+(router/mod.rs, worker/gossip_methods.rs, sync/range_sync): inbound gossip
+lands in the node's bounded priority queues; draining verifies batches and
+imports blocks; a block with an unknown parent triggers range sync from
+peers (sync/manager.rs:178)."""
+
+from __future__ import annotations
+
+from ..chain.attestation_processing import batch_verify_gossip_attestations
+from ..chain.beacon_chain import BlockError
+from ..scheduler import BeaconProcessor, WorkType
+from .topics import Topic
+
+
+class NetworkService:
+    def __init__(self, node_id: str, client, network):
+        self.node_id = node_id
+        self.client = client
+        self.network = network
+        network.register(node_id, self)
+
+    # -- outbound --------------------------------------------------------------
+
+    def publish_block(self, signed_block) -> None:
+        self.network.publish(self.node_id, Topic.BEACON_BLOCK, signed_block)
+
+    def publish_attestation(self, attestation) -> None:
+        self.network.publish(self.node_id, Topic.BEACON_ATTESTATION, attestation)
+
+    # -- inbound (router/mod.rs on_network_msg) --------------------------------
+
+    def on_gossip(self, topic: Topic, message) -> None:
+        p = self.client.processor
+        if topic == Topic.BEACON_BLOCK:
+            p.submit(WorkType.GOSSIP_BLOCK, message)
+        elif topic in (Topic.BEACON_ATTESTATION, Topic.BEACON_AGGREGATE_AND_PROOF):
+            p.submit(
+                WorkType.GOSSIP_ATTESTATION
+                if topic == Topic.BEACON_ATTESTATION
+                else WorkType.GOSSIP_AGGREGATE,
+                message,
+            )
+        elif topic == Topic.VOLUNTARY_EXIT:
+            self.client.op_pool.insert_voluntary_exit(message)
+        elif topic == Topic.PROPOSER_SLASHING:
+            self.client.op_pool.insert_proposer_slashing(message)
+        elif topic == Topic.ATTESTER_SLASHING:
+            self.client.op_pool.insert_attester_slashing(message)
+
+    # -- req/resp server (rpc BlocksByRange) -----------------------------------
+
+    def serve_blocks_by_range(self, start_slot: int, count: int):
+        store = self.client.chain.store
+        out = []
+        for root, signed in store.blocks.items():
+            if start_slot <= signed.message.slot < start_slot + count:
+                out.append(signed)
+        return sorted(out, key=lambda b: b.message.slot)
+
+    # -- processing with sync recovery -----------------------------------------
+
+    def process_pending(self) -> None:
+        """Drain the node's queues; unknown-parent blocks trigger range sync
+        (the simulator-scale stand-in for SyncManager + BackFillSync)."""
+        chain = self.client.chain
+
+        def handle_block(items):
+            for signed in items:
+                try:
+                    chain.process_block(signed)
+                except BlockError as e:
+                    if "unknown parent" in str(e):
+                        self._range_sync(signed)
+                    # other invalid blocks drop, as gossip verification would
+
+        def handle_atts(items):
+            results = batch_verify_gossip_attestations(chain, items)
+            for att, ok in zip(items, results):
+                if ok is True:
+                    self.client.op_pool.insert_attestation(att)
+
+        self.client.processor.drain(
+            {
+                WorkType.GOSSIP_BLOCK: handle_block,
+                WorkType.RPC_BLOCK: handle_block,
+                WorkType.DELAYED_BLOCK: handle_block,
+                WorkType.CHAIN_SEGMENT: handle_block,
+                WorkType.GOSSIP_ATTESTATION: handle_atts,
+                WorkType.GOSSIP_AGGREGATE: handle_atts,
+            }
+        )
+
+    def _range_sync(self, orphan_block) -> None:
+        """Fetch the missing range [head+1, orphan.slot) from peers and
+        import in order, then retry the orphan."""
+        chain = self.client.chain
+        head_slot = int(chain.head_state().slot)
+        target_slot = int(orphan_block.message.slot)
+        blocks = self.network.blocks_by_range(
+            self.node_id, head_slot + 1, max(0, target_slot - head_slot - 1)
+        )
+        for signed in blocks:
+            try:
+                chain.process_block(signed)
+            except BlockError:
+                pass
+        try:
+            chain.process_block(orphan_block)
+        except BlockError:
+            pass

@@ -1,0 +1,18 @@
+"""Gossip topic registry (types/topics.rs:11-28)."""
+
+from __future__ import annotations
+
+import enum
+
+
+class Topic(str, enum.Enum):
+    BEACON_BLOCK = "beacon_block"
+    BEACON_AGGREGATE_AND_PROOF = "beacon_aggregate_and_proof"
+    BEACON_ATTESTATION = "beacon_attestation"  # subnet topics collapse to one
+    VOLUNTARY_EXIT = "voluntary_exit"
+    PROPOSER_SLASHING = "proposer_slashing"
+    ATTESTER_SLASHING = "attester_slashing"
+
+    def full_name(self, fork_digest: bytes) -> str:
+        """Wire form: /eth2/{fork_digest}/{topic}/ssz_snappy."""
+        return f"/eth2/{fork_digest.hex()}/{self.value}/ssz_snappy"

@@ -43,10 +43,26 @@ def pack_bytes(data: bytes) -> list[bytes]:
     return [data[i : i + BYTES_PER_CHUNK] for i in range(0, len(data), BYTES_PER_CHUNK)]
 
 
+_NATIVE_MIN_CHUNKS = 8  # below this, ctypes call overhead beats the win
+_ZERO_TABLE = None
+
+
+def _native_zero_table() -> bytes:
+    global _ZERO_TABLE
+    if _ZERO_TABLE is None:
+        _ZERO_TABLE = b"".join(ZERO_HASHES)
+    return _ZERO_TABLE
+
+
 def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
     """Merkle root over `chunks`, virtually padded with zero chunks to
     next_pow_of_two(limit or len). Matches the spec's merkleize(): a limit
-    smaller than the chunk count is an error."""
+    smaller than the chunk count is an error.
+
+    Large chunk planes route through the native C hasher (SURVEY.md §2.7:
+    the eth2_hashing native-SHA role) when it built successfully; the
+    hashlib path is the always-available fallback and the differential
+    reference for it (tests/test_common.py)."""
     count = len(chunks)
     if limit is None:
         width = next_pow_of_two(count)
@@ -57,6 +73,11 @@ def merkleize(chunks: list[bytes], limit: int | None = None) -> bytes:
     depth = (width - 1).bit_length()
     if count == 0:
         return ZERO_HASHES[depth]
+    if count >= _NATIVE_MIN_CHUNKS:
+        from .. import native
+
+        if native.available():
+            return native.merkleize(b"".join(chunks), count, depth, _native_zero_table())
     layer = list(chunks)
     for d in range(depth):
         nxt = []

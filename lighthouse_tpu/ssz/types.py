@@ -553,13 +553,37 @@ class Container(metaclass=_ContainerMeta):
             raise DeserializationError(f"{cls.__name__}: trailing bytes")
         return cls(**values)
 
+    # Root memoization (the role of the reference's cached_tree_hash crate,
+    # restructured to stay sound under in-place mutation): subclasses set
+    # `root_memo_limit > 0` to memoize hash_tree_root keyed by the value's
+    # SERIALIZED BYTES — mutation changes the key, so stale hits are
+    # impossible, while unchanged values (the overwhelming case for e.g.
+    # Validator records across state copies) skip the merkle work entirely.
+    root_memo_limit: int = 0
+    _root_memo: dict | None = None
+
     @classmethod
     def hash_tree_root(cls, v: "Container") -> bytes:
+        memo = None
+        key = None
+        if cls.root_memo_limit:
+            if cls._root_memo is None:
+                cls._root_memo = {}
+            memo = cls._root_memo
+            key = cls.serialize(v)
+            got = memo.get(key)
+            if got is not None:
+                return got
         roots = [
             t.hash_tree_root(getattr(v, n))
             for n, t in zip(cls._field_names, cls._field_types)
         ]
-        return merkleize(roots)
+        root = merkleize(roots)
+        if memo is not None:
+            if len(memo) >= cls.root_memo_limit:
+                memo.clear()  # simple epoch-style reset; refill is cheap
+            memo[key] = root
+        return root
 
     @classmethod
     def default(cls) -> "Container":

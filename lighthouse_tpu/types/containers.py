@@ -67,6 +67,7 @@ class ForkData(Container):
 
 
 class Checkpoint(Container):
+    root_memo_limit = 1 << 16
     fields = [
         ("epoch", uint64),
         ("root", Bytes32),
@@ -75,6 +76,10 @@ class Checkpoint(Container):
 
 class Validator(Container):
     # /root/reference/consensus/types/src/validator.rs
+    # Registry entries rarely change within an epoch: memoized roots turn
+    # per-slot state hashing from O(validators * 15 sha256) into O(validators)
+    # dict hits (the cached_tree_hash role, SURVEY.md §2.2 row 9).
+    root_memo_limit = 1 << 20
     fields = [
         ("pubkey", Bytes48),
         ("withdrawal_credentials", Bytes32),
@@ -88,6 +93,7 @@ class Validator(Container):
 
 
 class AttestationData(Container):
+    root_memo_limit = 1 << 16
     fields = [
         ("slot", uint64),
         ("index", uint64),

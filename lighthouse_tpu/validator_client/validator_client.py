@@ -126,19 +126,41 @@ class BeaconNodeApi:
                         )
         return duties
 
-    def proposer_duties(self, epoch: int) -> dict[int, int]:
-        """slot -> proposer validator index for the epoch."""
+    def _state_at_epoch_start(self, epoch: int):
+        """A state advanced to exactly the epoch's start slot: walk head
+        ancestry back to the last block before the epoch, then advance its
+        post-state forward (proposer seeds depend on state.slot, so duties
+        must come from the epoch-start state, not the head state)."""
         ctx = self.chain.ctx
-        state = self.chain.head_state().copy()
         start = compute_start_slot_at_epoch(epoch, ctx.preset)
+        root = self.chain.head_root
+        block = self.chain.store.get_block(root)
+        while block is not None and block.message.slot >= start:
+            root = bytes(block.message.parent_root)
+            block = self.chain.store.get_block(root)
+        state = self.chain.store.get_state(root)
+        if state is None:  # pre-genesis epoch or pruned: fall back to head
+            state = self.chain.head_state()
+        state = state.copy()
+        if state.slot < start:
+            from ..state_transition import process_slots
+
+            process_slots(state, start, ctx)
+        return state
+
+    def proposer_duties(self, epoch: int) -> dict[int, int]:
+        """slot -> proposer validator index, from the epoch-start state
+        advanced sequentially (ONE state walk per epoch, not per slot)."""
+        ctx = self.chain.ctx
         from ..state_transition import process_slots
 
+        state = self._state_at_epoch_start(epoch)
+        start = compute_start_slot_at_epoch(epoch, ctx.preset)
         out = {}
         for slot in range(start, start + ctx.preset.slots_per_epoch):
-            s = state.copy()
-            if s.slot < slot:
-                process_slots(s, slot, ctx)
-            out[slot] = get_beacon_proposer_index(s, ctx.preset, ctx.spec)
+            if state.slot < slot:
+                process_slots(state, slot, ctx)
+            out[slot] = get_beacon_proposer_index(state, ctx.preset, ctx.spec)
         return out
 
     # attestation production/publish (validator/attestation_data + POST)
@@ -200,6 +222,7 @@ class ValidatorClient:
         self.store = store
         self.ctx = store.ctx
         self._duty_cache: dict[int, list[AttesterDuty]] = {}
+        self._proposer_cache: dict[int, dict[int, int]] = {}
 
     def _duties_for_epoch(self, epoch: int) -> list[AttesterDuty]:
         if epoch not in self._duty_cache:
@@ -217,7 +240,11 @@ class ValidatorClient:
         summary = {"proposed": None, "attested": 0}
 
         # -- block duty (block_service.rs) --
-        proposers = self.api.proposer_duties(epoch)
+        if epoch not in self._proposer_cache:
+            self._proposer_cache[epoch] = self.api.proposer_duties(epoch)
+            for e in [e for e in self._proposer_cache if e + 2 < epoch]:
+                del self._proposer_cache[e]
+        proposers = self._proposer_cache[epoch]
         proposer_index = proposers.get(slot)
         state = self.api.chain.head_state()
         if proposer_index is not None and proposer_index < len(state.validators):

@@ -1,0 +1,75 @@
+"""Client assembly: build -> gossip via scheduler -> shutdown -> resume."""
+
+import pytest
+
+from lighthouse_tpu.client import Client, ClientConfig
+from lighthouse_tpu.scheduler import WorkType
+
+
+@pytest.fixture()
+def client(tmp_path):
+    c = Client(
+        ClientConfig(
+            bls_backend="fake",
+            datadir=str(tmp_path / "db"),
+            http_enabled=False,
+            slasher_enabled=True,
+        )
+    )
+    yield c
+    c.shutdown()
+
+
+def _extend(client, slots):
+    from lighthouse_tpu.chain import BeaconChainHarness
+
+    h = BeaconChainHarness.__new__(BeaconChainHarness)
+    h.ctx = client.ctx
+    h.keypairs = [client.ctx.bls.interop_keypair(i) for i in range(16)]
+    h.chain = client.chain
+    return h, h.extend_chain(slots)
+
+
+def test_gossip_flows_through_scheduler(client):
+    h, head = _extend(client, 2)
+    state = client.chain.store.get_state(head)
+    atts = h.attestations_for_slot(state, head, int(state.slot))
+    for a in atts:
+        assert client.submit_gossip_attestation(a)
+    n = client.process_pending()
+    assert n >= 1
+    # accepted attestations landed in the op pool and the slasher queue
+    assert client.op_pool.attestations
+    assert client.slasher.queue
+    client.per_slot_task(int(state.slot) + 1)
+    assert not client.slasher.queue  # processed
+
+
+def test_shutdown_persist_and_resume(tmp_path):
+    cfg = ClientConfig(bls_backend="fake", datadir=str(tmp_path / "db"), http_enabled=False)
+    c1 = Client(cfg)
+    _extend(c1, 3)
+    head = c1.chain.head_root
+    c1.shutdown()
+
+    c2 = Client(cfg)
+    assert c2.chain.genesis_block_root == c1.chain.genesis_block_root
+    assert c2.chain.head_root == head
+    # chain continues after resume
+    h, new_head = _extend(c2, 1)
+    assert c2.chain.head_state().slot == 4
+    c2.shutdown()
+
+
+def test_http_server_lifecycle(tmp_path):
+    import json
+    import urllib.request
+
+    c = Client(ClientConfig(bls_backend="fake", http_enabled=True))
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{c.http.port}/eth/v1/node/version"
+        ) as r:
+            assert "lighthouse-tpu" in json.load(r)["data"]["version"]
+    finally:
+        c.shutdown()

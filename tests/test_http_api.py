@@ -54,6 +54,11 @@ def test_node_and_genesis_endpoints(server):
     assert fin["data"]["finalized"]["epoch"] == "0"
     _, hdr = _get(srv, "/eth/v1/beacon/headers/head")
     assert hdr["data"]["root"] == "0x" + chain.genesis_block_root.hex()
+    # the returned header must hash to the returned root (API contract)
+    from lighthouse_tpu.types.containers import BeaconBlockHeader
+
+    header = decode(hdr["data"]["header"]["message"], BeaconBlockHeader)
+    assert BeaconBlockHeader.hash_tree_root(header) == chain.genesis_block_root
 
 
 def test_metrics_endpoint(server):
@@ -128,3 +133,9 @@ def test_error_shapes(server):
     with pytest.raises(urllib.error.HTTPError) as e:
         _get(srv, "/eth/v1/beacon/headers/0x" + "ab" * 32)
     assert e.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/eth/v1/beacon/headers/garbage")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/eth/v1/beacon/states/0xzz/root")
+    assert e.value.code == 400

@@ -1,0 +1,105 @@
+"""Slasher: double votes, surround votes, double proposals; the produced
+slashings must pass the state-transition's own slashability checks."""
+
+import pytest
+
+from lighthouse_tpu.slasher import Slasher
+from lighthouse_tpu.state_transition import TransitionContext
+from lighthouse_tpu.state_transition.helpers import is_slashable_attestation_data
+from lighthouse_tpu.types.containers import (
+    AttestationData,
+    BeaconBlockHeader,
+    Checkpoint,
+    SignedBeaconBlockHeader,
+)
+
+
+@pytest.fixture()
+def ctx():
+    return TransitionContext.minimal("fake")
+
+
+def att(ctx, indices, source, target, root=b"\x01"):
+    return ctx.types.IndexedAttestation(
+        attesting_indices=list(indices),
+        data=AttestationData(
+            slot=target * 8,
+            index=0,
+            beacon_block_root=root * 32,
+            source=Checkpoint(epoch=source, root=b"\x0a" * 32),
+            target=Checkpoint(epoch=target, root=b"\x0b" * 32),
+        ),
+        signature=b"\x00" * 96,
+    )
+
+
+def test_no_slashing_for_consistent_votes(ctx):
+    s = Slasher(ctx)
+    s.accept_attestation(att(ctx, [1, 2], 0, 1))
+    s.accept_attestation(att(ctx, [1, 2], 1, 2))
+    s.accept_attestation(att(ctx, [1, 2], 2, 3))
+    atts, blocks = s.process_queued(current_epoch=3)
+    assert atts == [] and blocks == []
+
+
+def test_double_vote_detected(ctx):
+    s = Slasher(ctx)
+    s.accept_attestation(att(ctx, [5], 0, 1, root=b"\x01"))
+    s.accept_attestation(att(ctx, [5], 0, 1, root=b"\x02"))  # same target, diff data
+    atts, _ = s.process_queued(current_epoch=2)
+    assert len(atts) == 1
+    sl = atts[0]
+    assert is_slashable_attestation_data(sl.attestation_1.data, sl.attestation_2.data)
+
+
+def test_surround_vote_detected_both_directions(ctx):
+    s = Slasher(ctx)
+    s.accept_attestation(att(ctx, [7], 2, 3))
+    s.accept_attestation(att(ctx, [7], 1, 4))  # surrounds (2,3)
+    atts, _ = s.process_queued(current_epoch=4)
+    assert len(atts) == 1
+    sl = atts[0]
+    # attestation_1 surrounds attestation_2 (ordering required by
+    # process_attester_slashing's is_slashable_attestation_data)
+    assert is_slashable_attestation_data(sl.attestation_1.data, sl.attestation_2.data)
+
+    s2 = Slasher(ctx)
+    s2.accept_attestation(att(ctx, [7], 1, 4))
+    s2.accept_attestation(att(ctx, [7], 2, 3))  # surrounded by (1,4)
+    atts2, _ = s2.process_queued(current_epoch=4)
+    assert len(atts2) == 1
+    sl2 = atts2[0]
+    assert is_slashable_attestation_data(sl2.attestation_1.data, sl2.attestation_2.data)
+
+
+def test_double_proposal_detected(ctx):
+    s = Slasher(ctx)
+
+    def header(root):
+        return SignedBeaconBlockHeader(
+            message=BeaconBlockHeader(
+                slot=9, proposer_index=3, parent_root=root * 32,
+                state_root=b"\x00" * 32, body_root=b"\x00" * 32,
+            ),
+            signature=b"\x00" * 96,
+        )
+
+    s.accept_block_header(header(b"\x01"))
+    s.accept_block_header(header(b"\x01"))  # identical: not slashable
+    s.accept_block_header(header(b"\x02"))  # different: slashable
+    _, blocks = s.process_queued(current_epoch=2)
+    assert len(blocks) == 1
+    ps = blocks[0]
+    assert ps.signed_header_1.message.slot == ps.signed_header_2.message.slot
+    assert ps.signed_header_1.message != ps.signed_header_2.message
+
+
+def test_history_pruning(ctx):
+    from lighthouse_tpu.slasher import SlasherConfig
+
+    s = Slasher(ctx, SlasherConfig(history_length=2))
+    s.accept_attestation(att(ctx, [1], 0, 1))
+    s.process_queued(current_epoch=1)
+    assert s.history
+    s.process_queued(current_epoch=10)  # far future: everything pruned
+    assert not s.history and not s.attestation_by_target
