@@ -30,6 +30,7 @@ def cmd_beacon_node(args) -> int:
         slasher_enabled=args.slasher,
         interop_validators=args.interop_validators,
         genesis_time=args.genesis_time or int(time.time()),
+        checkpoint_url=args.checkpoint_sync_url,
     )
     client = Client(cfg)
     print(f"beacon node up: preset={args.preset} bls={args.bls_backend}")
@@ -148,6 +149,7 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--slasher", action="store_true")
     bn.add_argument("--interop-validators", type=int, default=16)
     bn.add_argument("--genesis-time", type=int)
+    bn.add_argument("--checkpoint-sync-url", help="boot from a trusted node's finalized state")
     bn.add_argument("--run-slots", type=int, help="run N slots then exit (testing)")
     bn.set_defaults(fn=cmd_beacon_node)
 

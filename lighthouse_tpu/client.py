@@ -34,6 +34,9 @@ class ClientConfig:
     interop_validators: int = 16
     genesis_time: int = 1600000000
     slots_per_restore_point: int = 32
+    # checkpoint sync: boot from a trusted node's finalized state
+    # (ClientGenesis::CheckpointSyncUrl, client/src/builder.rs:264-330)
+    checkpoint_url: str | None = None
 
 
 class Client:
@@ -56,12 +59,15 @@ class Client:
             store = MemoryStore()
 
         # genesis strategy (builder.rs:218-330): resume from store if it has
-        # a persisted head, else interop genesis
+        # a persisted head; else checkpoint-sync from a trusted URL; else
+        # interop genesis
         resumed = False
         if isinstance(store, HotColdDB) and store.genesis_root is not None:
             genesis_state = store.get_state(store.genesis_root)
             resumed = genesis_state is not None
-        if not resumed:
+        if not resumed and config.checkpoint_url:
+            genesis_state = self._fetch_checkpoint_state(config.checkpoint_url, ctx)
+        elif not resumed:
             genesis_state = interop_genesis_state(
                 config.interop_validators, config.genesis_time, ctx
             )
@@ -76,6 +82,20 @@ class Client:
         self.http: HttpApiServer | None = None
         if config.http_enabled:
             self.http = HttpApiServer(self.api, port=config.http_port).start()
+
+    @staticmethod
+    def _fetch_checkpoint_state(url: str, ctx):
+        """Download the trusted node's finalized state (SSZ) and anchor the
+        chain on it. BeaconChain anchors fork choice on any self-consistent
+        state, so a mid-chain finalized state works exactly like genesis —
+        history backfills later via range sync."""
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"{url}/eth/v2/debug/beacon/states/finalized", timeout=60
+        ) as r:
+            data = r.read()
+        return ctx.types.BeaconState.deserialize(data)
 
     def _replay_fork_choice(self, store: HotColdDB) -> None:
         """Rebuild fork choice from persisted blocks (ClientGenesis::FromStore)."""

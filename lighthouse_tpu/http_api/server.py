@@ -111,6 +111,15 @@ class _Handler(BaseHTTPRequestHandler):
             url = urlparse(self.path)
             parts = [p for p in url.path.split("/") if p]
             q = parse_qs(url.query)
+            # Large downloads (the SSZ state) serialize under the lock but
+            # stream to the socket outside it, so a slow checkpoint-sync
+            # client cannot stall every other route.
+            if len(parts) == 6 and parts[:4] == ["eth", "v2", "debug", "beacon"]:
+                with _CHAIN_LOCK:
+                    state = self._state_for(parts[5])
+                    body = self.chain.ctx.types.BeaconState.serialize(state)
+                self._send(200, body, "application/octet-stream")
+                return
             with _CHAIN_LOCK:
                 self._route_get(parts, q)
         except ApiError as e:

@@ -73,3 +73,40 @@ def test_http_server_lifecycle(tmp_path):
             assert "lighthouse-tpu" in json.load(r)["data"]["version"]
     finally:
         c.shutdown()
+
+
+def test_checkpoint_sync_boot(tmp_path):
+    """Checkpoint sync: node B boots from node A's finalized state over
+    HTTP, then catches up to A's head from gossip (builder.rs:264-330)."""
+    from lighthouse_tpu.types import MINIMAL_PRESET
+
+    a = Client(ClientConfig(bls_backend="fake", http_enabled=True))
+    try:
+        _extend(a, 4 * MINIMAL_PRESET.slots_per_epoch)
+        fin = a.chain.head_state().finalized_checkpoint
+        assert fin.epoch >= 1
+
+        b = Client(
+            ClientConfig(
+                bls_backend="fake",
+                http_enabled=False,
+                checkpoint_url=f"http://127.0.0.1:{a.http.port}",
+            )
+        )
+        # B is anchored on A's finalized block
+        assert b.chain.head_root == bytes(fin.root)
+        anchor_slot = int(b.chain.head_state().slot)
+
+        # feed A's post-anchor blocks to B in slot order
+        blocks = sorted(
+            (s for s in a.chain.store.blocks.values() if s.message.slot > anchor_slot),
+            key=lambda s: s.message.slot,
+        )
+        for signed in blocks:
+            b.submit_gossip_block(signed)
+            b.chain.slot_clock.set_slot(int(signed.message.slot))
+            b.process_pending()
+        assert b.chain.head_root == a.chain.head_root
+        assert b.chain.head_state().slot == a.chain.head_state().slot
+    finally:
+        a.shutdown()
