@@ -356,7 +356,7 @@ def verify_signature_sets(sets: list[SignatureSet], rng=None) -> bool:
         if any(pk.point.inf for pk in s.signing_keys):
             return False
 
-    from ...common.metrics import BLS_BATCH_SECONDS, BLS_SETS_TOTAL
+    from ....common.metrics import BLS_BATCH_SECONDS, BLS_SETS_TOTAL
 
     with BLS_BATCH_SECONDS.time():
         staged = stage_sets(sets, rng=rng)
