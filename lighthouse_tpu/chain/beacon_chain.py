@@ -42,9 +42,14 @@ class BlockError(Exception):
 
 class BeaconChain:
     def __init__(self, genesis_state, ctx: TransitionContext, store=None, slot_clock=None):
+        from .events import EventBus, ValidatorMonitor
+
         self.ctx = ctx
         self.store = store if store is not None else MemoryStore()
         self.slot_clock = slot_clock if slot_clock is not None else ManualSlotClock()
+        self.events = EventBus()
+        self.validator_monitor = ValidatorMonitor()
+        self._last_finalized_epoch = 0
 
         t = ctx.types
         genesis_state_root = t.BeaconState.hash_tree_root(genesis_state)
@@ -98,12 +103,18 @@ class BeaconChain:
         block_root = t.BeaconBlock.hash_tree_root(block)
         self.store.put_block(block_root, signed_block)
         self.store.put_state(block_root, state)
+        self.events.emit(
+            "block", slot=int(block.slot), block="0x" + block_root.hex()
+        )
+        self.validator_monitor.on_block_proposed(int(block.proposer_index), int(block.slot))
 
         # fork choice: the block, then every attestation it carries
         self.fork_choice.on_tick(max(self.slot(), block.slot))
         self.fork_choice.on_block(block, block_root, state)
         for att in block.body.attestations:
             indexed = get_indexed_attestation(state, att, t, self.ctx.preset, self.ctx.spec)
+            for vi in indexed.attesting_indices:
+                self.validator_monitor.on_attestation_included(int(vi), int(att.data.slot))
             try:
                 self.fork_choice.on_attestation(indexed, is_from_block=True)
             except ForkChoiceError:
@@ -121,7 +132,24 @@ class BeaconChain:
         self.fork_choice.on_attestation(indexed)
 
     def recompute_head(self) -> bytes:
+        old = self.head_root
         self.head_root = self.fork_choice.get_head()
+        if self.head_root != old:
+            state = self.store.get_state(self.head_root)
+            self.events.emit(
+                "head",
+                slot=int(state.slot) if state else None,
+                block="0x" + self.head_root.hex(),
+            )
+            if state is not None:
+                fin = state.finalized_checkpoint
+                if fin.epoch > self._last_finalized_epoch:
+                    self._last_finalized_epoch = fin.epoch
+                    self.events.emit(
+                        "finalized_checkpoint",
+                        epoch=int(fin.epoch),
+                        block="0x" + bytes(fin.root).hex(),
+                    )
         return self.head_root
 
     def slot(self) -> int:

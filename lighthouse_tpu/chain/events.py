@@ -1,0 +1,75 @@
+"""Chain event bus + validator monitor.
+
+Counterparts of /root/reference/beacon_node/beacon_chain/src/events.rs
+(the SSE feed http_api serves) and validator_monitor.rs (per-validator
+inclusion tracking for registered keys).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Event:
+    kind: str  # "head" | "block" | "attestation" | "finalized_checkpoint"
+    data: dict
+
+
+class EventBus:
+    """Fan-out of chain events to bounded subscriber queues (events.rs)."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._subs: list[queue.Queue] = []
+        self._lock = threading.Lock()
+
+    def subscribe(self) -> queue.Queue:
+        q: queue.Queue = queue.Queue(maxsize=self.capacity)
+        with self._lock:
+            self._subs.append(q)
+        return q
+
+    def unsubscribe(self, q: queue.Queue) -> None:
+        with self._lock:
+            if q in self._subs:
+                self._subs.remove(q)
+
+    def emit(self, kind: str, **data) -> None:
+        ev = Event(kind=kind, data=data)
+        with self._lock:
+            subs = list(self._subs)
+        for q in subs:
+            try:
+                q.put_nowait(ev)
+            except queue.Full:
+                pass  # slow consumer: drop, never block the chain
+
+
+class ValidatorMonitor:
+    """Tracks registered validators' participation (validator_monitor.rs:
+    per-epoch attestation inclusion + proposals for monitored keys)."""
+
+    def __init__(self):
+        self.monitored: set[int] = set()
+        self.attestations: dict[int, list[int]] = {}  # index -> slots seen
+        self.blocks: dict[int, list[int]] = {}
+
+    def register(self, validator_index: int) -> None:
+        self.monitored.add(validator_index)
+
+    def on_attestation_included(self, validator_index: int, slot: int) -> None:
+        if validator_index in self.monitored:
+            self.attestations.setdefault(validator_index, []).append(slot)
+
+    def on_block_proposed(self, validator_index: int, slot: int) -> None:
+        if validator_index in self.monitored:
+            self.blocks.setdefault(validator_index, []).append(slot)
+
+    def summary(self, validator_index: int) -> dict:
+        return {
+            "attestations": len(self.attestations.get(validator_index, [])),
+            "blocks": len(self.blocks.get(validator_index, [])),
+        }

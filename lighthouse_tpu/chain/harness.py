@@ -35,6 +35,16 @@ class BeaconChainHarness:
         genesis = interop_genesis_state(n_validators, genesis_time, ctx)
         self.chain = BeaconChain(genesis, ctx, slot_clock=ManualSlotClock())
 
+    @classmethod
+    def for_chain(cls, chain: BeaconChain, n_validators: int) -> "BeaconChainHarness":
+        """Wrap an EXISTING chain (e.g. one a Client built) so tests can
+        drive it with interop validators."""
+        h = cls.__new__(cls)
+        h.ctx = chain.ctx
+        h.keypairs = [chain.ctx.bls.interop_keypair(i) for i in range(n_validators)]
+        h.chain = chain
+        return h
+
     # -- signing helpers -------------------------------------------------------
 
     def _sk_for(self, validator_index: int):

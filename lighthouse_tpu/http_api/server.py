@@ -120,6 +120,9 @@ class _Handler(BaseHTTPRequestHandler):
                     body = self.chain.ctx.types.BeaconState.serialize(state)
                 self._send(200, body, "application/octet-stream")
                 return
+            if parts == ["eth", "v1", "events"]:
+                self._serve_events(q)  # long-lived stream: never holds the lock
+                return
             with _CHAIN_LOCK:
                 self._route_get(parts, q)
         except ApiError as e:
@@ -252,6 +255,40 @@ class _Handler(BaseHTTPRequestHandler):
             )
         else:
             raise ApiError(404, "unknown endpoint")
+
+    def _serve_events(self, q):
+        """SSE stream of chain events (events.rs -> http_api /eth/v1/events).
+        `topics` query filters kinds; the stream ends when the client
+        disconnects or after `max_events` (testing hook)."""
+        import queue as _queue
+
+        # accept both ?topics=a,b and the OpenAPI repeated-key ?topics=a&topics=b
+        topics = {t for param in q.get("topics", []) for t in param.split(",")} - {""}
+        max_events = int((q.get("max_events") or ["0"])[0])
+        sub = self.chain.events.subscribe()
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.end_headers()
+            sent = 0
+            while max_events == 0 or sent < max_events:
+                try:
+                    ev = sub.get(timeout=10.0)
+                except _queue.Empty:
+                    self.wfile.write(b": keepalive\n\n")
+                    self.wfile.flush()
+                    continue
+                if topics and ev.kind not in topics:
+                    continue
+                payload = json.dumps(ev.data)
+                self.wfile.write(f"event: {ev.kind}\ndata: {payload}\n\n".encode())
+                self.wfile.flush()
+                sent += 1
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            self.chain.events.unsubscribe(sub)
 
     # -- POST --------------------------------------------------------------
 
