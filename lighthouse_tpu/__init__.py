@@ -1,0 +1,11 @@
+"""lighthouse_tpu: a TPU-native Ethereum consensus framework.
+
+The batched BLS12-381 verification hot core runs as JAX/XLA programs on
+the accelerator (crypto/bls/jax_backend, parallel/); the consensus host —
+SSZ, types, state transition, fork choice, chain, storage, scheduler,
+networking seam, APIs, validator client, slasher — is built around
+feeding it device-sized batches. See ARCHITECTURE.md for the component
+map against the reference implementation.
+"""
+
+__version__ = "0.4.0"
