@@ -52,7 +52,7 @@ class BeaconChain:
         self._last_finalized_epoch = 0
 
         t = ctx.types
-        genesis_state_root = t.BeaconState.hash_tree_root(genesis_state)
+        genesis_state_root = type(genesis_state).hash_tree_root(genesis_state)
         header = BeaconBlockHeader(
             slot=genesis_state.slot,
             proposer_index=genesis_state.latest_block_header.proposer_index,
@@ -100,7 +100,7 @@ class BeaconChain:
             except StateTransitionError as e:
                 raise BlockError(str(e)) from e
 
-        block_root = t.BeaconBlock.hash_tree_root(block)
+        block_root = type(block).hash_tree_root(block)
         self.store.put_block(block_root, signed_block)
         self.store.put_state(block_root, state)
         self.events.emit(
@@ -168,15 +168,18 @@ class BeaconChain:
         proposer_slashings=(),
         attester_slashings=(),
         graffiti: bytes = b"\x00" * 32,
+        sync_aggregate=None,
     ):
-        """Build an (unsigned) block on `state` advanced to `slot`; returns
-        (block, post_state). The caller signs it."""
+        """Build an (unsigned) block on `state` advanced to `slot`, of the
+        state's fork variant; returns (block, post_state). The caller signs
+        it."""
         t = self.ctx.types
         if state.slot < slot:
             process_slots(state, slot, self.ctx)
+        ft = t.for_fork(t.fork_of(state))
         parent_root = BeaconBlockHeader.hash_tree_root(state.latest_block_header)
         proposer_index = get_beacon_proposer_index(state, self.ctx.preset, self.ctx.spec)
-        body = t.BeaconBlockBody(
+        body_kwargs = dict(
             randao_reveal=randao_reveal,
             eth1_data=state.eth1_data,
             graffiti=graffiti,
@@ -186,30 +189,49 @@ class BeaconChain:
             deposits=list(deposits),
             voluntary_exits=list(exits),
         )
-        block = t.BeaconBlock(
+        if t.fork_of(state) != "phase0":
+            body_kwargs["sync_aggregate"] = (
+                sync_aggregate if sync_aggregate is not None else empty_sync_aggregate(t)
+            )
+        body = ft.BeaconBlockBody(**body_kwargs)
+        block = ft.BeaconBlock(
             slot=slot,
             proposer_index=proposer_index,
             parent_root=parent_root,
             state_root=b"\x00" * 32,
             body=body,
         )
-        signed = t.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
+        signed = ft.SignedBeaconBlock(message=block, signature=b"\x00" * 96)
         per_block_processing(
             state, signed, self.ctx, strategy=BlockSignatureStrategy.NO_VERIFICATION
         )
-        block.state_root = t.BeaconState.hash_tree_root(state)
+        block.state_root = type(state).hash_tree_root(state)
         return block, state
 
     def sign_block(self, block, secret_key):
-        """Proposal signature (signature_sets.rs:55 semantics)."""
+        """Proposal signature (signature_sets.rs:55 semantics). The fork
+        version comes from the SCHEDULE at the block's epoch (not the parent
+        state's fork record, which is stale for the first block of a new
+        fork's epoch)."""
+        from ..types import schedule_domain
+
+        spec = self.ctx.spec
         state = self.store.get_state(bytes(block.parent_root)) or self.head_state()
-        domain = get_domain(
-            state,
-            self.ctx.spec.domain_beacon_proposer,
-            compute_epoch_at_slot(block.slot, self.ctx.preset),
-            self.ctx.preset,
+        epoch = compute_epoch_at_slot(block.slot, self.ctx.preset)
+        domain = schedule_domain(
+            spec, spec.domain_beacon_proposer, epoch, state.genesis_validators_root
         )
         root = compute_signing_root(block, domain)
-        return self.ctx.types.SignedBeaconBlock(
-            message=block, signature=secret_key.sign(root).to_bytes()
-        )
+        signed_cls = self.ctx.types.for_fork(self.ctx.types.fork_of(block.body)).SignedBeaconBlock
+        return signed_cls(message=block, signature=secret_key.sign(root).to_bytes())
+
+
+def empty_sync_aggregate(t):
+    """No participants + the infinity signature — the valid empty aggregate
+    (sync_aggregate.rs SyncAggregate::new)."""
+    from ..crypto.bls.constants import G2_POINT_AT_INFINITY
+
+    return t.SyncAggregate(
+        sync_committee_bits=[False] * t.preset.sync_committee_size,
+        sync_committee_signature=G2_POINT_AT_INFINITY,
+    )

@@ -95,6 +95,46 @@ class BeaconChainHarness:
             data_by_index[index] = att
         return list(data_by_index.values())
 
+    # -- sync committee (altair+) ----------------------------------------------
+
+    def sync_aggregate_for_parent(self, state, slot: int):
+        """Full-participation SyncAggregate over the parent block root (the
+        message the committee owes in the block at `slot`,
+        altair/sync_committee.rs process_sync_aggregate). Returns None on
+        phase0 states."""
+        t, preset, spec = self.ctx.types, self.ctx.preset, self.ctx.spec
+        if t.fork_of(state) == "phase0":
+            return None
+        from ..ssz.types import Bytes32
+        from ..types.containers import BeaconBlockHeader
+
+        prev_slot = max(slot, 1) - 1
+        parent_root = BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+        domain = get_domain(
+            state, spec.domain_sync_committee, prev_slot // preset.slots_per_epoch, preset
+        )
+        sd = SigningData(object_root=Bytes32.hash_tree_root(parent_root), domain=domain)
+        root = SigningData.hash_tree_root(sd)
+        pk_to_vi = {
+            self.keypairs[i][1].to_bytes(): i for i in range(len(self.keypairs))
+        }
+        bits, sigs = [], []
+        for pkb in state.current_sync_committee.pubkeys:
+            vi = pk_to_vi.get(bytes(pkb))
+            if vi is None:
+                bits.append(False)
+            else:
+                bits.append(True)
+                sigs.append(self._sk_for(vi).sign(root))
+        from .beacon_chain import empty_sync_aggregate
+
+        if not sigs:
+            return empty_sync_aggregate(t)
+        return t.SyncAggregate(
+            sync_committee_bits=bits,
+            sync_committee_signature=self.ctx.bls.aggregate_signatures(sigs).to_bytes(),
+        )
+
     # -- chain building --------------------------------------------------------
 
     def add_block_at_slot(
@@ -112,7 +152,11 @@ class BeaconChainHarness:
         proposer = get_beacon_proposer_index(state, self.ctx.preset, self.ctx.spec)
         reveal = self.randao_reveal(state, proposer, slot)
         block, _post = chain.produce_block_on_state(
-            state, slot, reveal, attestations=attestations
+            state,
+            slot,
+            reveal,
+            attestations=attestations,
+            sync_aggregate=self.sync_aggregate_for_parent(state, slot),
         )
         signed = chain.sign_block(block, self._sk_for(proposer))
         root = chain.process_block(signed, strategy=strategy)

@@ -113,18 +113,20 @@ def cmd_lcli(args) -> int:
     )
     if args.lcli_cmd == "interop-genesis":
         state = interop_genesis_state(args.validators, args.genesis_time, ctx)
-        data = ctx.types.BeaconState.serialize(state)
+        data = type(state).serialize(state)
         with open(args.output, "wb") as f:
             f.write(data)
-        root = ctx.types.BeaconState.hash_tree_root(state)
+        root = type(state).hash_tree_root(state)
         print(f"genesis state ({len(data)} bytes) -> {args.output}; root 0x{root.hex()}")
         return 0
     if args.lcli_cmd == "skip-slots":
         with open(args.state, "rb") as f:
-            state = ctx.types.BeaconState.deserialize(f.read())
+            from .types import decode_beacon_state
+
+            state = decode_beacon_state(f.read(), ctx.types, ctx.spec)
         process_slots(state, state.slot + args.slots, ctx)
         with open(args.output, "wb") as f:
-            f.write(ctx.types.BeaconState.serialize(state))
+            f.write(type(state).serialize(state))
         print(f"advanced to slot {state.slot} -> {args.output}")
         return 0
     if args.lcli_cmd == "pretty-ssz":

@@ -95,7 +95,9 @@ class Client:
             f"{url}/eth/v2/debug/beacon/states/finalized", timeout=60
         ) as r:
             data = r.read()
-        return ctx.types.BeaconState.deserialize(data)
+        from .types import decode_beacon_state
+
+        return decode_beacon_state(data, ctx.types, ctx.spec)
 
     def _replay_fork_choice(self, store: HotColdDB) -> None:
         """Rebuild fork choice from persisted blocks (ClientGenesis::FromStore)."""

@@ -92,3 +92,9 @@ def _validate() -> None:
 
 
 _validate()
+
+
+# Compressed serialization of the G2 point at infinity: the valid signature
+# of an empty sync aggregate (spec G2_POINT_AT_INFINITY; sync_aggregate.rs
+# SyncAggregate::new).
+G2_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 95

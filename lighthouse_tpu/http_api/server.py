@@ -117,7 +117,7 @@ class _Handler(BaseHTTPRequestHandler):
             if len(parts) == 6 and parts[:4] == ["eth", "v2", "debug", "beacon"]:
                 with _CHAIN_LOCK:
                     state = self._state_for(parts[5])
-                    body = self.chain.ctx.types.BeaconState.serialize(state)
+                    body = type(state).serialize(state)
                 self._send(200, body, "application/octet-stream")
                 return
             if parts == ["eth", "v1", "events"]:
@@ -181,7 +181,7 @@ class _Handler(BaseHTTPRequestHandler):
             elif parts[5] == "root":
                 self._send(
                     200,
-                    _data({"root": "0x" + t.BeaconState.hash_tree_root(state).hex()}),
+                    _data({"root": "0x" + type(state).hash_tree_root(state).hex()}),
                 )
             else:
                 raise ApiError(404, "unknown state endpoint")
@@ -201,7 +201,7 @@ class _Handler(BaseHTTPRequestHandler):
                     slot=lh.slot,
                     proposer_index=lh.proposer_index,
                     parent_root=lh.parent_root,
-                    state_root=t.BeaconState.hash_tree_root(state),
+                    state_root=type(state).hash_tree_root(state),
                     body_root=lh.body_root,
                 )
             else:
@@ -211,7 +211,7 @@ class _Handler(BaseHTTPRequestHandler):
                     proposer_index=b.proposer_index,
                     parent_root=b.parent_root,
                     state_root=b.state_root,
-                    body_root=t.BeaconBlockBody.hash_tree_root(b.body),
+                    body_root=type(b.body).hash_tree_root(b.body),
                 )
             self._send(
                 200,

@@ -69,17 +69,48 @@ class OperationPool:
         cur = get_current_epoch(state, preset)
         prev = get_previous_epoch(state, preset)
 
-        # precompute roots of already-included attestations once (C+A, not C*A)
-        ad_root = ctx.types.AttestationData.hash_tree_root
-        seen_by_root: dict[bytes, set[int]] = {}
-        for epoch_list in (state.previous_epoch_attestations, state.current_epoch_attestations):
-            for pa in epoch_list:
-                try:
-                    seen_by_root.setdefault(ad_root(pa.data), set()).update(
-                        get_attesting_indices(state, pa.data, pa.aggregation_bits, preset, spec)
-                    )
-                except StateTransitionError:
-                    pass
+        # Precompute who is already credited, once (C+A, not C*A). Phase0
+        # records inclusion per attestation-data (pending lists); altair+
+        # records it per validator as participation flags — an attestation is
+        # only fresh for validators still missing the target flag
+        # (operation_pool's altair scoring, op pool lib.rs get_attestations).
+        if ctx.types.fork_of(state) == "phase0":
+            ad_root = ctx.types.AttestationData.hash_tree_root
+            seen_by_root: dict[bytes, set[int]] = {}
+            for epoch_list in (
+                state.previous_epoch_attestations,
+                state.current_epoch_attestations,
+            ):
+                for pa in epoch_list:
+                    try:
+                        seen_by_root.setdefault(ad_root(pa.data), set()).update(
+                            get_attesting_indices(
+                                state, pa.data, pa.aggregation_bits, preset, spec
+                            )
+                        )
+                    except StateTransitionError:
+                        pass
+
+            def seen_for(data_root: bytes, epoch: int) -> set[int]:
+                return seen_by_root.get(data_root, set())
+
+        else:
+            from ..state_transition.altair import TIMELY_TARGET_FLAG_INDEX, has_flag
+
+            seen_by_epoch = {
+                e: {
+                    i
+                    for i, f in enumerate(participation)
+                    if has_flag(f, TIMELY_TARGET_FLAG_INDEX)
+                }
+                for e, participation in (
+                    (prev, state.previous_epoch_participation),
+                    (cur, state.current_epoch_participation),
+                )
+            }
+
+            def seen_for(data_root: bytes, epoch: int) -> set[int]:
+                return seen_by_epoch[epoch]
 
         candidates = []
         for data_root, bucket in self.attestations.items():
@@ -106,7 +137,7 @@ class OperationPool:
                     )
                 except StateTransitionError:
                     continue
-                seen = seen_by_root.get(data_root, set())
+                seen = seen_for(data_root, epoch)
                 fresh = {
                     i: state.validators[i].effective_balance
                     for i in indices

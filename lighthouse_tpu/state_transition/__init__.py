@@ -1,11 +1,15 @@
-"""State transition (phase0): per-slot/block/epoch processing, signature
-sets, and the bulk block-signature verifier.
+"""State transition (phase0 / altair / bellatrix): per-slot/block/epoch
+processing, signature sets, and the bulk block-signature verifier.
 
 Counterpart of /root/reference/consensus/state_processing (SURVEY.md §2.2):
 the layer that turns consensus objects into the device-sized signature
-batches the TPU verifier consumes.
+batches the TPU verifier consumes. Fork multiplexing dispatches on the
+container classes' fork_name markers (per_slot._process_epoch_for_fork,
+per_block.process_operations); scheduled upgrades run inside process_slots.
 """
 
+from .altair import upgrade_to_altair
+from .bellatrix import upgrade_to_bellatrix
 from .context import PubkeyCache, TransitionContext
 from .helpers import StateTransitionError
 from .per_block import (
@@ -24,6 +28,8 @@ from .per_slot import per_slot_processing, process_slot, process_slots, state_tr
 from .genesis import interop_genesis_state
 
 __all__ = [
+    "upgrade_to_altair",
+    "upgrade_to_bellatrix",
     "PubkeyCache",
     "TransitionContext",
     "StateTransitionError",

@@ -52,6 +52,9 @@ class TransitionContext:
     spec: ChainSpec
     bls: Any
     pubkeys: PubkeyCache = None  # type: ignore[assignment]
+    # Engine-API seam for bellatrix payload validation (None -> optimistic
+    # accept; see state_transition.bellatrix.OptimisticEngine)
+    execution_engine: Any = None
 
     def __post_init__(self):
         if self.pubkeys is None:

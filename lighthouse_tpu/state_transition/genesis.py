@@ -65,6 +65,24 @@ def interop_genesis_state(n_validators: int, genesis_time: int, ctx: TransitionC
     # genesis_validators_root commits to the registry (spec
     # initialize_beacon_state_from_eth1 tail).
     state.genesis_validators_root = _validators_root(t, state)
+    return _upgrade_genesis_to_scheduled_fork(state, ctx)
+
+
+def _upgrade_genesis_to_scheduled_fork(state, ctx: TransitionContext):
+    """A network whose fork schedule starts a later fork at epoch 0 boots
+    directly into that fork (the reference builds genesis per the schedule,
+    beacon_chain/src/builder.rs genesis handling): apply the upgrades the
+    schedule owes at the genesis epoch."""
+    if ctx.spec.altair_fork_epoch == GENESIS_EPOCH:
+        from .altair import upgrade_to_altair
+
+        upgrade_to_altair(state, ctx)
+        # genesis fork has no "previous": both versions are altair's
+        state.fork.previous_version = ctx.spec.altair_fork_version
+    if ctx.spec.bellatrix_fork_epoch == GENESIS_EPOCH:
+        from .bellatrix import upgrade_to_bellatrix
+
+        upgrade_to_bellatrix(state, ctx)
     return state
 
 
@@ -147,7 +165,7 @@ def initialize_beacon_state_from_eth1(
             v.activation_epoch = GENESIS_EPOCH
 
     state.genesis_validators_root = _validators_root(t, state)
-    return state
+    return _upgrade_genesis_to_scheduled_fork(state, ctx)
 
 
 def is_valid_genesis_state(state, ctx: TransitionContext) -> bool:

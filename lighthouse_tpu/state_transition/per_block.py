@@ -120,6 +120,16 @@ class BlockSignatureVerifier:
                 )
             )
 
+    def include_sync_aggregate(self, block) -> None:
+        """block_signature_verifier.rs include_sync_aggregate (altair+)."""
+        if not hasattr(block.body, "sync_aggregate"):
+            return
+        s = sigsets.sync_aggregate_signature_set(
+            self.state, block.body.sync_aggregate, self.ctx.bls, self.ctx.preset, self.ctx.spec
+        )
+        if s is not None:
+            self.sets.append(s)
+
     def include_all_signatures(self, signed_block) -> None:
         """block_signature_verifier.rs:120 include_all_signatures: proposal +
         everything else. Deposits are deliberately NOT included: deposit
@@ -135,6 +145,7 @@ class BlockSignatureVerifier:
         self.include_attester_slashings(block)
         self.include_attestations(block)
         self.include_exits(block)
+        self.include_sync_aggregate(block)
 
     def verify(self) -> None:
         """ONE backend batch call (block_signature_verifier.rs:333-361; jax
@@ -177,7 +188,7 @@ def process_block_header(state, block, ctx: TransitionContext) -> None:
         proposer_index=block.proposer_index,
         parent_root=block.parent_root,
         state_root=b"\x00" * 32,  # filled by the next process_slot
-        body_root=ctx.types.BeaconBlockBody.hash_tree_root(block.body),
+        body_root=type(block.body).hash_tree_root(block.body),
     )
     proposer = state.validators[block.proposer_index]
     if proposer.slashed:
@@ -359,6 +370,10 @@ def apply_deposit(state, deposit_data, ctx: TransitionContext) -> None:
             return
         state.validators.append(get_validator_from_deposit(deposit_data, ctx.spec))
         state.balances.append(deposit_data.amount)
+        if ctx.types.fork_of(state) != "phase0":
+            state.previous_epoch_participation.append(0)
+            state.current_epoch_participation.append(0)
+            state.inactivity_scores.append(0)
     else:
         increase_balance(state, pubkeys.index(pk), deposit_data.amount)
 
@@ -396,12 +411,16 @@ def process_operations(state, body, ctx: TransitionContext, verify: bool) -> Non
         raise StateTransitionError(
             f"expected {expected_deposits} deposits, block has {len(body.deposits)}"
         )
+    if ctx.types.fork_of(state) == "phase0":
+        attestation_fn = process_attestation
+    else:
+        from .altair import process_attestation_altair as attestation_fn
     for ps in body.proposer_slashings:
         process_proposer_slashing(state, ps, ctx, verify)
     for als in body.attester_slashings:
         process_attester_slashing(state, als, ctx, verify)
     for att in body.attestations:
-        process_attestation(state, att, ctx, verify)
+        attestation_fn(state, att, ctx, verify)
     for dep in body.deposits:
         process_deposit(state, dep, ctx)
     for ex in body.voluntary_exits:
@@ -435,6 +454,17 @@ def per_block_processing(
     verify_randao = verify_each or strategy == BlockSignatureStrategy.VERIFY_RANDAO
 
     process_block_header(state, block, ctx)
+    if ctx.types.fork_of(state) == "bellatrix":
+        from .bellatrix import is_execution_enabled, process_execution_payload
+
+        if is_execution_enabled(state, block.body, ctx):
+            process_execution_payload(state, block.body.execution_payload, ctx)
     process_randao(state, block.body, ctx, verify=verify_randao)
     process_eth1_data(state, block.body, ctx)
     process_operations(state, block.body, ctx, verify=verify_each)
+    if hasattr(block.body, "sync_aggregate"):
+        from .altair import process_sync_aggregate
+
+        # in VERIFY_BULK mode the aggregate's signature was already part of
+        # the one batched device call above
+        process_sync_aggregate(state, block.body.sync_aggregate, ctx, verify=verify_each)

@@ -15,6 +15,12 @@ Error::ValidatorUnknown / BadSignature split from verification failure.
 The signed *message* in every set is a 32-byte signing root
 (compute_signing_root = hash_tree_root(SigningData{object_root, domain})),
 so sets from heterogeneous operations batch uniformly on the device.
+
+Domains come from the ChainSpec fork SCHEDULE (types.schedule_domain), not
+the state's fork record: for on-schedule states the two agree, and the
+schedule stays correct when verification runs against a head state that has
+not yet crossed a fork boundary the signed epoch is in (gossip at a fork's
+first slots).
 """
 
 from __future__ import annotations
@@ -24,7 +30,7 @@ from ..types import (
     ChainSpec,
     Preset,
     compute_signing_root,
-    get_domain,
+    schedule_domain,
 )
 from ..types.containers import SigningData
 from .helpers import StateTransitionError
@@ -56,8 +62,11 @@ def block_proposal_signature_set(
     block = signed_block.message
     if block.proposer_index != proposer_index:
         raise StateTransitionError("incorrect proposer index")
-    domain = get_domain(
-        state, spec.domain_beacon_proposer, compute_epoch(block.slot, preset), preset
+    domain = schedule_domain(
+        spec,
+        spec.domain_beacon_proposer,
+        compute_epoch(block.slot, preset),
+        state.genesis_validators_root,
     )
     root = compute_signing_root(block, domain)
     return bls.SignatureSet(
@@ -75,7 +84,9 @@ def randao_signature_set(state, randao_reveal, proposer_index: int, bls, pubkey,
     """signature_sets.rs randao_signature_set: message is the epoch (as SSZ
     uint64) under DOMAIN_RANDAO."""
     epoch = compute_epoch(state.slot, preset)
-    domain = get_domain(state, spec.domain_randao, epoch, preset)
+    domain = schedule_domain(
+        spec, spec.domain_randao, epoch, state.genesis_validators_root
+    )
     root = _signing_root_for_uint64(epoch, domain)
     return bls.SignatureSet(
         signature=_decode_signature(bls, randao_reveal),
@@ -88,7 +99,12 @@ def block_header_signature_set(state, signed_header, bls, pubkey, preset: Preset
     """One half of a proposer slashing (signature_sets.rs
     proposer_slashing_signature_set builds two of these)."""
     header = signed_header.message
-    domain = get_domain(state, spec.domain_beacon_proposer, compute_epoch(header.slot, preset), preset)
+    domain = schedule_domain(
+        spec,
+        spec.domain_beacon_proposer,
+        compute_epoch(header.slot, preset),
+        state.genesis_validators_root,
+    )
     root = compute_signing_root(header, domain)
     return bls.SignatureSet(
         signature=_decode_signature(bls, signed_header.signature),
@@ -107,7 +123,12 @@ def proposer_slashing_signature_sets(state, slashing, bls, pubkey, preset: Prese
 def indexed_attestation_signature_set(state, indexed, bls, pubkey, preset: Preset, spec: ChainSpec):
     """signature_sets.rs indexed_attestation_signature_set: one set with ALL
     attesting pubkeys (aggregate verify of the same message)."""
-    domain = get_domain(state, spec.domain_beacon_attester, indexed.data.target.epoch, preset)
+    domain = schedule_domain(
+        spec,
+        spec.domain_beacon_attester,
+        indexed.data.target.epoch,
+        state.genesis_validators_root,
+    )
     root = compute_signing_root(indexed.data, domain)
     keys = [_resolve(pubkey, i) for i in indexed.attesting_indices]
     return bls.SignatureSet(
@@ -151,7 +172,9 @@ def deposit_signature_set(deposit_data, bls, spec: ChainSpec):
 
 def exit_signature_set(state, signed_exit, bls, pubkey, preset: Preset, spec: ChainSpec):
     exit_msg = signed_exit.message
-    domain = get_domain(state, spec.domain_voluntary_exit, exit_msg.epoch, preset)
+    domain = schedule_domain(
+        spec, spec.domain_voluntary_exit, exit_msg.epoch, state.genesis_validators_root
+    )
     root = compute_signing_root(exit_msg, domain)
     return bls.SignatureSet(
         signature=_decode_signature(bls, signed_exit.signature),
@@ -163,7 +186,12 @@ def exit_signature_set(state, signed_exit, bls, pubkey, preset: Preset, spec: Ch
 def selection_proof_signature_set(state, slot: int, aggregator_index: int, selection_proof, bls, pubkey, preset: Preset, spec: ChainSpec):
     """signature_sets.rs signed_aggregate_selection_proof_signature_set:
     message is the slot (SSZ uint64) under DOMAIN_SELECTION_PROOF."""
-    domain = get_domain(state, spec.domain_selection_proof, compute_epoch(slot, preset), preset)
+    domain = schedule_domain(
+        spec,
+        spec.domain_selection_proof,
+        compute_epoch(slot, preset),
+        state.genesis_validators_root,
+    )
     root = _signing_root_for_uint64(slot, domain)
     return bls.SignatureSet(
         signature=_decode_signature(bls, selection_proof),
@@ -172,11 +200,141 @@ def selection_proof_signature_set(state, slot: int, aggregator_index: int, selec
     )
 
 
+def _decompress_cached(bls, raw: bytes):
+    """Decompress a G1 pubkey with a module-level memo (sync committees reuse
+    the same few hundred keys every slot of a 256-epoch period)."""
+    key = (id(bls), raw)
+    pk = _PK_MEMO.get(key)
+    if pk is None:
+        try:
+            pk = bls.PublicKey.from_bytes(raw)
+        except bls.DecodeError as e:
+            raise StateTransitionError(f"undecodable sync committee pubkey: {e}") from e
+        if len(_PK_MEMO) > 1 << 16:
+            _PK_MEMO.clear()
+        _PK_MEMO[key] = pk
+    return pk
+
+
+_PK_MEMO: dict = {}
+
+
+def sync_aggregate_signature_set(state, sync_aggregate, bls, preset: Preset, spec: ChainSpec):
+    """signature_sets.rs sync_aggregate_signature_set: the current sync
+    committee's participants sign the PREVIOUS slot's block root. Returns
+    None for the valid no-participants + infinity-signature case (the
+    eth_fast_aggregate_verify carve-out) and raises for no participants with
+    a real signature."""
+    from ..ssz.types import Bytes32
+
+    bits = list(sync_aggregate.sync_committee_bits)
+    participant_pubkeys = [
+        bytes(pk) for pk, bit in zip(state.current_sync_committee.pubkeys, bits) if bit
+    ]
+    sig_bytes = bytes(sync_aggregate.sync_committee_signature)
+    if not participant_pubkeys:
+        from ..crypto.bls.constants import G2_POINT_AT_INFINITY
+
+        if sig_bytes == G2_POINT_AT_INFINITY:
+            return None
+        raise StateTransitionError("sync aggregate: no participants but non-infinity sig")
+
+    previous_slot = max(state.slot, 1) - 1
+    domain = schedule_domain(
+        spec,
+        spec.domain_sync_committee,
+        previous_slot // preset.slots_per_epoch,
+        state.genesis_validators_root,
+    )
+    block_root = get_block_root_at_slot_for_sync(state, previous_slot, preset)
+    sd = SigningData(object_root=Bytes32.hash_tree_root(block_root), domain=domain)
+    root = SigningData.hash_tree_root(sd)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, sig_bytes),
+        signing_keys=[_decompress_cached(bls, raw) for raw in participant_pubkeys],
+        message=root,
+    )
+
+
+def get_block_root_at_slot_for_sync(state, slot: int, preset: Preset) -> bytes:
+    """get_block_root_at_slot, with the genesis-slot carve-out (state.slot ==
+    0 -> slot == 0 and the root is the latest header's parent chain: zeroed —
+    handled by the normal path everywhere past genesis)."""
+    from .helpers import get_block_root_at_slot
+
+    if slot == state.slot:  # only at genesis (previous_slot clamps to 0)
+        return bytes(state.block_roots[slot % preset.slots_per_historical_root])
+    return get_block_root_at_slot(state, slot, preset)
+
+
+def sync_committee_message_signature_set(state, message, bls, pubkey, preset: Preset, spec: ChainSpec):
+    """A single validator's sync-committee message (sync duty signing; the
+    VC-side counterpart of the aggregate above)."""
+    from ..ssz.types import Bytes32
+
+    domain = schedule_domain(
+        spec,
+        spec.domain_sync_committee,
+        compute_epoch(message.slot, preset),
+        state.genesis_validators_root,
+    )
+    sd = SigningData(
+        object_root=Bytes32.hash_tree_root(bytes(message.beacon_block_root)), domain=domain
+    )
+    root = SigningData.hash_tree_root(sd)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, message.signature),
+        signing_keys=[_resolve(pubkey, message.validator_index)],
+        message=root,
+    )
+
+
+def sync_selection_proof_signature_set(
+    state, slot: int, subcommittee_index: int, aggregator_index: int, proof, bls, pubkey,
+    preset: Preset, spec: ChainSpec, types=None,
+):
+    """signature_sets.rs signed_sync_aggregate_selection_proof_signature_set:
+    message is SyncAggregatorSelectionData{slot, subcommittee_index}."""
+    domain = schedule_domain(
+        spec,
+        spec.domain_sync_committee_selection_proof,
+        compute_epoch(slot, preset),
+        state.genesis_validators_root,
+    )
+    sd_type = types.SyncAggregatorSelectionData
+    obj = sd_type(slot=slot, subcommittee_index=subcommittee_index)
+    root = compute_signing_root(obj, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, proof),
+        signing_keys=[_resolve(pubkey, aggregator_index)],
+        message=root,
+    )
+
+
+def contribution_and_proof_signature_set(state, signed_contribution, bls, pubkey, preset: Preset, spec: ChainSpec):
+    msg = signed_contribution.message
+    domain = schedule_domain(
+        spec,
+        spec.domain_contribution_and_proof,
+        compute_epoch(msg.contribution.slot, preset),
+        state.genesis_validators_root,
+    )
+    root = compute_signing_root(msg, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, signed_contribution.signature),
+        signing_keys=[_resolve(pubkey, msg.aggregator_index)],
+        message=root,
+    )
+
+
 def aggregate_and_proof_signature_set(state, signed_aggregate, bls, pubkey, preset: Preset, spec: ChainSpec):
     """signature_sets.rs signed_aggregate_signature_set."""
     msg = signed_aggregate.message
-    domain = get_domain(
-        state, spec.domain_aggregate_and_proof, compute_epoch(msg.aggregate.data.slot, preset), preset
+    domain = schedule_domain(
+        spec,
+        spec.domain_aggregate_and_proof,
+        compute_epoch(msg.aggregate.data.slot, preset),
+        state.genesis_validators_root,
     )
     root = compute_signing_root(msg, domain)
     return bls.SignatureSet(

@@ -48,8 +48,10 @@ class HotColdDB(Store):
         self.block_parent[root] = bytes(signed_block.message.parent_root)
         self.block_slot[root] = int(signed_block.message.slot)
         if self.path:
-            t = self.ctx.types
-            self._write(self.path / "blocks" / f"{root.hex()}.ssz", t.SignedBeaconBlock.serialize(signed_block))
+            self._write(
+                self.path / "blocks" / f"{root.hex()}.ssz",
+                type(signed_block).serialize(signed_block),
+            )
 
     def get_block(self, root: bytes):
         return self.blocks.get(bytes(root))
@@ -60,7 +62,7 @@ class HotColdDB(Store):
         if self.path:
             self._write(
                 self.path / "states" / f"{root.hex()}.ssz",
-                self.ctx.types.BeaconState.serialize(state),
+                type(state).serialize(state),
             )
 
     def get_state(self, root: bytes):
@@ -162,15 +164,21 @@ class HotColdDB(Store):
             self._write(self.path / "meta.json", json.dumps(self.meta).encode())
 
     def _load_disk(self) -> None:
+        from ..types import decode_beacon_state, decode_signed_block
+
         t = self.ctx.types
         meta_p = self.path / "meta.json"
         if meta_p.exists():
             self.meta = json.loads(meta_p.read_text())
         for p in (self.path / "blocks").glob("*.ssz"):
-            signed = t.SignedBeaconBlock.deserialize(p.read_bytes())
+            signed = decode_signed_block(
+                p.read_bytes(), t, self.ctx.spec, self.ctx.preset
+            )
             root = bytes.fromhex(p.stem)
             self.blocks[root] = signed
             self.block_parent[root] = bytes(signed.message.parent_root)
             self.block_slot[root] = int(signed.message.slot)
         for p in (self.path / "states").glob("*.ssz"):
-            self.hot_states[bytes.fromhex(p.stem)] = t.BeaconState.deserialize(p.read_bytes())
+            self.hot_states[bytes.fromhex(p.stem)] = decode_beacon_state(
+                p.read_bytes(), t, self.ctx.spec
+            )

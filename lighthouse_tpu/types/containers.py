@@ -24,12 +24,15 @@ isinstance checks hold across presets.
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import lru_cache
 
 from ..ssz.types import (
     Bitlist,
     Bitvector,
+    ByteList,
     Bytes4,
+    Bytes20,
     Bytes32,
     Bytes48,
     Bytes96,
@@ -37,7 +40,9 @@ from ..ssz.types import (
     List,
     Vector,
     boolean,
+    uint8,
     uint64,
+    uint256,
 )
 from .spec import (
     DEPOSIT_CONTRACT_TREE_DEPTH,
@@ -200,6 +205,16 @@ _SHARED = {
 }
 
 
+@dataclass(frozen=True)
+class ForkTypes:
+    """The four fork-variant container classes for one fork."""
+
+    BeaconState: type
+    BeaconBlock: type
+    BeaconBlockBody: type
+    SignedBeaconBlock: type
+
+
 class SpecTypes:
     """All consensus container types for one preset."""
 
@@ -315,6 +330,155 @@ class SpecTypes:
                 ("finalized_checkpoint", Checkpoint),
             ]
 
+        # -- altair (beacon_state.rs Altair variant; sync_committee.rs) --------
+
+        class SyncCommittee(Container):
+            fields = [
+                ("pubkeys", Vector(Bytes48, p.sync_committee_size)),
+                ("aggregate_pubkey", Bytes48),
+            ]
+
+        class SyncAggregate(Container):
+            fields = [
+                ("sync_committee_bits", Bitvector(p.sync_committee_size)),
+                ("sync_committee_signature", Bytes96),
+            ]
+
+        class SyncCommitteeMessage(Container):
+            # consensus/types/src/sync_committee_message.rs
+            fields = [
+                ("slot", uint64),
+                ("beacon_block_root", Bytes32),
+                ("validator_index", uint64),
+                ("signature", Bytes96),
+            ]
+
+        class SyncCommitteeContribution(Container):
+            # consensus/types/src/sync_committee_contribution.rs
+            fields = [
+                ("slot", uint64),
+                ("beacon_block_root", Bytes32),
+                ("subcommittee_index", uint64),
+                ("aggregation_bits", Bitvector(p.sync_committee_size // 4)),
+                ("signature", Bytes96),
+            ]
+
+        class ContributionAndProof(Container):
+            fields = [
+                ("aggregator_index", uint64),
+                ("contribution", SyncCommitteeContribution),
+                ("selection_proof", Bytes96),
+            ]
+
+        class SignedContributionAndProof(Container):
+            fields = [
+                ("message", ContributionAndProof),
+                ("signature", Bytes96),
+            ]
+
+        class SyncAggregatorSelectionData(Container):
+            fields = [
+                ("slot", uint64),
+                ("subcommittee_index", uint64),
+            ]
+
+        class BeaconBlockBodyAltair(Container):
+            fields = BeaconBlockBody.fields + [("sync_aggregate", SyncAggregate)]
+
+        class BeaconBlockAltair(Container):
+            fields = [
+                ("slot", uint64),
+                ("proposer_index", uint64),
+                ("parent_root", Bytes32),
+                ("state_root", Bytes32),
+                ("body", BeaconBlockBodyAltair),
+            ]
+
+        class SignedBeaconBlockAltair(Container):
+            fields = [
+                ("message", BeaconBlockAltair),
+                ("signature", Bytes96),
+            ]
+
+        class BeaconStateAltair(Container):
+            # beacon_state.rs:202 (Altair variant): pending attestations are
+            # replaced by per-validator participation flag bytes; adds
+            # inactivity scores and the two sync committees.
+            fields = [
+                ("genesis_time", uint64),
+                ("genesis_validators_root", Bytes32),
+                ("slot", uint64),
+                ("fork", Fork),
+                ("latest_block_header", BeaconBlockHeader),
+                ("block_roots", Vector(Bytes32, p.slots_per_historical_root)),
+                ("state_roots", Vector(Bytes32, p.slots_per_historical_root)),
+                ("historical_roots", List(Bytes32, p.historical_roots_limit)),
+                ("eth1_data", Eth1Data),
+                ("eth1_data_votes", List(Eth1Data, p.slots_per_eth1_voting_period)),
+                ("eth1_deposit_index", uint64),
+                ("validators", List(Validator, p.validator_registry_limit)),
+                ("balances", List(uint64, p.validator_registry_limit)),
+                ("randao_mixes", Vector(Bytes32, p.epochs_per_historical_vector)),
+                ("slashings", Vector(uint64, p.epochs_per_slashings_vector)),
+                ("previous_epoch_participation", List(uint8, p.validator_registry_limit)),
+                ("current_epoch_participation", List(uint8, p.validator_registry_limit)),
+                ("justification_bits", Bitvector(JUSTIFICATION_BITS_LENGTH)),
+                ("previous_justified_checkpoint", Checkpoint),
+                ("current_justified_checkpoint", Checkpoint),
+                ("finalized_checkpoint", Checkpoint),
+                ("inactivity_scores", List(uint64, p.validator_registry_limit)),
+                ("current_sync_committee", SyncCommittee),
+                ("next_sync_committee", SyncCommittee),
+            ]
+
+        # -- bellatrix (execution_payload.rs; beacon_state.rs Merge variant) ---
+
+        Transaction = ByteList(p.max_bytes_per_transaction)
+
+        class ExecutionPayload(Container):
+            fields = [
+                ("parent_hash", Bytes32),
+                ("fee_recipient", Bytes20),
+                ("state_root", Bytes32),
+                ("receipts_root", Bytes32),
+                ("logs_bloom", Vector(uint8, p.bytes_per_logs_bloom)),
+                ("prev_randao", Bytes32),
+                ("block_number", uint64),
+                ("gas_limit", uint64),
+                ("gas_used", uint64),
+                ("timestamp", uint64),
+                ("extra_data", ByteList(p.max_extra_data_bytes)),
+                ("base_fee_per_gas", uint256),
+                ("block_hash", Bytes32),
+                ("transactions", List(Transaction, p.max_transactions_per_payload)),
+            ]
+
+        class ExecutionPayloadHeader(Container):
+            fields = ExecutionPayload.fields[:-1] + [("transactions_root", Bytes32)]
+
+        class BeaconBlockBodyBellatrix(Container):
+            fields = BeaconBlockBodyAltair.fields + [("execution_payload", ExecutionPayload)]
+
+        class BeaconBlockBellatrix(Container):
+            fields = [
+                ("slot", uint64),
+                ("proposer_index", uint64),
+                ("parent_root", Bytes32),
+                ("state_root", Bytes32),
+                ("body", BeaconBlockBodyBellatrix),
+            ]
+
+        class SignedBeaconBlockBellatrix(Container):
+            fields = [
+                ("message", BeaconBlockBellatrix),
+                ("signature", Bytes96),
+            ]
+
+        class BeaconStateBellatrix(Container):
+            fields = BeaconStateAltair.fields + [
+                ("latest_execution_payload_header", ExecutionPayloadHeader),
+            ]
+
         self.IndexedAttestation = IndexedAttestation
         self.PendingAttestation = PendingAttestation
         self.Attestation = Attestation
@@ -326,6 +490,24 @@ class SpecTypes:
         self.BeaconBlock = BeaconBlock
         self.SignedBeaconBlock = SignedBeaconBlock
         self.BeaconState = BeaconState
+        self.SyncCommittee = SyncCommittee
+        self.SyncAggregate = SyncAggregate
+        self.SyncCommitteeMessage = SyncCommitteeMessage
+        self.SyncCommitteeContribution = SyncCommitteeContribution
+        self.ContributionAndProof = ContributionAndProof
+        self.SignedContributionAndProof = SignedContributionAndProof
+        self.SyncAggregatorSelectionData = SyncAggregatorSelectionData
+        self.BeaconBlockBodyAltair = BeaconBlockBodyAltair
+        self.BeaconBlockAltair = BeaconBlockAltair
+        self.SignedBeaconBlockAltair = SignedBeaconBlockAltair
+        self.BeaconStateAltair = BeaconStateAltair
+        self.Transaction = Transaction
+        self.ExecutionPayload = ExecutionPayload
+        self.ExecutionPayloadHeader = ExecutionPayloadHeader
+        self.BeaconBlockBodyBellatrix = BeaconBlockBodyBellatrix
+        self.BeaconBlockBellatrix = BeaconBlockBellatrix
+        self.SignedBeaconBlockBellatrix = SignedBeaconBlockBellatrix
+        self.BeaconStateBellatrix = BeaconStateBellatrix
 
         for cls_name in (
             "IndexedAttestation",
@@ -339,9 +521,71 @@ class SpecTypes:
             "BeaconBlock",
             "SignedBeaconBlock",
             "BeaconState",
+            "SyncCommittee",
+            "SyncAggregate",
+            "SyncCommitteeMessage",
+            "SyncCommitteeContribution",
+            "ContributionAndProof",
+            "SignedContributionAndProof",
+            "SyncAggregatorSelectionData",
+            "BeaconBlockBodyAltair",
+            "BeaconBlockAltair",
+            "SignedBeaconBlockAltair",
+            "BeaconStateAltair",
+            "ExecutionPayload",
+            "ExecutionPayloadHeader",
+            "BeaconBlockBodyBellatrix",
+            "BeaconBlockBellatrix",
+            "SignedBeaconBlockBellatrix",
+            "BeaconStateBellatrix",
         ):
             getattr(self, cls_name).__name__ = f"{cls_name}_{p.name}"
             getattr(self, cls_name).__qualname__ = f"{cls_name}_{p.name}"
+
+        # fork-name markers + per-fork namespaces (the role of the
+        # reference's superstruct fork enums + ForkName mapping,
+        # /root/reference/consensus/types/src/fork_name.rs)
+        for cls in (BeaconState, BeaconBlock, BeaconBlockBody, SignedBeaconBlock):
+            cls.fork_name = "phase0"
+        for cls in (
+            BeaconStateAltair,
+            BeaconBlockAltair,
+            BeaconBlockBodyAltair,
+            SignedBeaconBlockAltair,
+        ):
+            cls.fork_name = "altair"
+        for cls in (
+            BeaconStateBellatrix,
+            BeaconBlockBellatrix,
+            BeaconBlockBodyBellatrix,
+            SignedBeaconBlockBellatrix,
+        ):
+            cls.fork_name = "bellatrix"
+
+        self.forks = {
+            "phase0": ForkTypes(BeaconState, BeaconBlock, BeaconBlockBody, SignedBeaconBlock),
+            "altair": ForkTypes(
+                BeaconStateAltair,
+                BeaconBlockAltair,
+                BeaconBlockBodyAltair,
+                SignedBeaconBlockAltair,
+            ),
+            "bellatrix": ForkTypes(
+                BeaconStateBellatrix,
+                BeaconBlockBellatrix,
+                BeaconBlockBodyBellatrix,
+                SignedBeaconBlockBellatrix,
+            ),
+        }
+
+    def for_fork(self, fork_name: str) -> "ForkTypes":
+        return self.forks[fork_name]
+
+    @staticmethod
+    def fork_of(obj) -> str:
+        """Fork name of a state/block/body instance (isinstance-free: the
+        classes carry a fork_name marker)."""
+        return type(obj).fork_name
 
 
 @lru_cache(maxsize=None)

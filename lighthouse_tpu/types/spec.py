@@ -28,6 +28,31 @@ ENDIANNESS = "little"
 
 BASE_REWARDS_PER_EPOCH = 4
 
+# -- altair participation flags (consensus/types/src/consts.rs altair) ---------
+
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+
+TIMELY_SOURCE_WEIGHT = 14
+TIMELY_TARGET_WEIGHT = 26
+TIMELY_HEAD_WEIGHT = 14
+SYNC_REWARD_WEIGHT = 2
+PROPOSER_WEIGHT = 8
+WEIGHT_DENOMINATOR = 64
+
+PARTICIPATION_FLAG_WEIGHTS = [
+    TIMELY_SOURCE_WEIGHT,
+    TIMELY_TARGET_WEIGHT,
+    TIMELY_HEAD_WEIGHT,
+]
+
+SYNC_COMMITTEE_SUBNET_COUNT = 4
+
+# Fork names in activation order (the reference's ForkName enum,
+# /root/reference/consensus/types/src/fork_name.rs).
+FORK_ORDER = ("phase0", "altair", "bellatrix")
+
 
 @dataclass(frozen=True)
 class Preset:
@@ -128,8 +153,13 @@ class ChainSpec:
     """Runtime constants (chain_spec.rs). Defaults are the mainnet phase0
     values; a Minimal network overrides the timing/churn fields."""
 
-    # fork versions
+    # fork schedule (chain_spec.rs altair_fork_{version,epoch} etc.;
+    # FAR_FUTURE_EPOCH = fork not scheduled)
     genesis_fork_version: bytes = b"\x00\x00\x00\x00"
+    altair_fork_version: bytes = b"\x01\x00\x00\x00"
+    altair_fork_epoch: int = FAR_FUTURE_EPOCH
+    bellatrix_fork_version: bytes = b"\x02\x00\x00\x00"
+    bellatrix_fork_epoch: int = FAR_FUTURE_EPOCH
     # domains (4-byte type prefixes)
     domain_beacon_proposer: bytes = b"\x00\x00\x00\x00"
     domain_beacon_attester: bytes = b"\x01\x00\x00\x00"
@@ -138,6 +168,9 @@ class ChainSpec:
     domain_voluntary_exit: bytes = b"\x04\x00\x00\x00"
     domain_selection_proof: bytes = b"\x05\x00\x00\x00"
     domain_aggregate_and_proof: bytes = b"\x06\x00\x00\x00"
+    domain_sync_committee: bytes = b"\x07\x00\x00\x00"
+    domain_sync_committee_selection_proof: bytes = b"\x08\x00\x00\x00"
+    domain_contribution_and_proof: bytes = b"\x09\x00\x00\x00"
     # gwei
     min_deposit_amount: int = 10**9
     max_effective_balance: int = 32 * 10**9
@@ -154,13 +187,27 @@ class ChainSpec:
     # churn
     min_per_epoch_churn_limit: int = 4
     churn_limit_quotient: int = 2**16
-    # rewards & penalties (phase0 values)
+    # rewards & penalties (phase0 values; per-fork overrides below)
     base_reward_factor: int = 64
     whistleblower_reward_quotient: int = 512
     proposer_reward_quotient: int = 8
     inactivity_penalty_quotient: int = 2**26
     min_slashing_penalty_quotient: int = 128
     proportional_slashing_multiplier: int = 1
+    # altair rewards & penalties + inactivity scoring
+    inactivity_penalty_quotient_altair: int = 3 * 2**24
+    min_slashing_penalty_quotient_altair: int = 64
+    proportional_slashing_multiplier_altair: int = 2
+    inactivity_score_bias: int = 4
+    inactivity_score_recovery_rate: int = 16
+    # bellatrix rewards & penalties
+    inactivity_penalty_quotient_bellatrix: int = 2**24
+    min_slashing_penalty_quotient_bellatrix: int = 32
+    proportional_slashing_multiplier_bellatrix: int = 3
+    # merge transition
+    terminal_total_difficulty: int = 2**256 - 2**10
+    terminal_block_hash: bytes = b"\x00" * 32
+    terminal_block_hash_activation_epoch: int = FAR_FUTURE_EPOCH
     # hysteresis
     hysteresis_quotient: int = 4
     hysteresis_downward_multiplier: int = 1
@@ -179,11 +226,36 @@ class ChainSpec:
             active_validator_count // self.churn_limit_quotient,
         )
 
+    # -- fork schedule (fork_name.rs / ChainSpec::fork_name_at_epoch) ----------
+
+    def fork_epoch(self, fork_name: str) -> int:
+        return {
+            "phase0": 0,
+            "altair": self.altair_fork_epoch,
+            "bellatrix": self.bellatrix_fork_epoch,
+        }[fork_name]
+
+    def fork_version(self, fork_name: str) -> bytes:
+        return {
+            "phase0": self.genesis_fork_version,
+            "altair": self.altair_fork_version,
+            "bellatrix": self.bellatrix_fork_version,
+        }[fork_name]
+
+    def fork_name_at_epoch(self, epoch: int) -> str:
+        name = "phase0"
+        for candidate in FORK_ORDER:
+            if self.fork_epoch(candidate) <= epoch:
+                name = candidate
+        return name
+
 
 MAINNET_SPEC = ChainSpec()
 
 MINIMAL_SPEC = ChainSpec(
     genesis_fork_version=b"\x00\x00\x00\x01",
+    altair_fork_version=b"\x01\x00\x00\x01",
+    bellatrix_fork_version=b"\x02\x00\x00\x01",
     seconds_per_slot=6,
     min_genesis_active_validator_count=64,
     min_genesis_time=1578009600,

@@ -30,6 +30,7 @@ from ..types import (
     compute_signing_root,
     compute_start_slot_at_epoch,
     get_domain,
+    schedule_domain,
 )
 from ..types.containers import Checkpoint, SigningData
 from .slashing_protection import SlashingDatabase, SlashingProtectionError
@@ -62,10 +63,15 @@ class ValidatorStore:
         return list(self.keys)
 
     def sign_block(self, pubkey: bytes, block, state):
+        # schedule_domain, NOT get_domain on the head state: the head state's
+        # fork record is stale when proposing the first block of a new
+        # fork's epoch (the verifier checks against the post-slots state)
         ctx = self.ctx
-        domain = get_domain(
-            state, ctx.spec.domain_beacon_proposer,
-            compute_epoch_at_slot(block.slot, ctx.preset), ctx.preset,
+        domain = schedule_domain(
+            ctx.spec,
+            ctx.spec.domain_beacon_proposer,
+            compute_epoch_at_slot(block.slot, ctx.preset),
+            state.genesis_validators_root,
         )
         root = compute_signing_root(block, domain)
         self.slashing_db.check_and_insert_block_proposal(pubkey, block.slot, root)
@@ -73,8 +79,11 @@ class ValidatorStore:
 
     def sign_attestation(self, pubkey: bytes, data, state) -> bytes:
         ctx = self.ctx
-        domain = get_domain(
-            state, ctx.spec.domain_beacon_attester, data.target.epoch, ctx.preset
+        domain = schedule_domain(
+            ctx.spec,
+            ctx.spec.domain_beacon_attester,
+            data.target.epoch,
+            state.genesis_validators_root,
         )
         root = compute_signing_root(data, domain)
         self.slashing_db.check_and_insert_attestation(
@@ -84,7 +93,9 @@ class ValidatorStore:
 
     def sign_randao(self, pubkey: bytes, epoch: int, state) -> bytes:
         ctx = self.ctx
-        domain = get_domain(state, ctx.spec.domain_randao, epoch, ctx.preset)
+        domain = schedule_domain(
+            ctx.spec, ctx.spec.domain_randao, epoch, state.genesis_validators_root
+        )
         sd = SigningData(object_root=uint64.hash_tree_root(epoch), domain=domain)
         return self.keys[pubkey].sign(SigningData.hash_tree_root(sd)).to_bytes()
 
@@ -253,7 +264,8 @@ class ValidatorClient:
                 reveal = self.store.sign_randao(pk, epoch, state)
                 block = self.api.produce_block(slot, reveal)
                 sig = self.store.sign_block(pk, block, state)
-                signed = ctx.types.SignedBeaconBlock(message=block, signature=sig)
+                signed_cls = ctx.types.for_fork(ctx.types.fork_of(block.body)).SignedBeaconBlock
+                signed = signed_cls(message=block, signature=sig)
                 summary["proposed"] = self.api.publish_block(signed)
 
         # -- attestation duties at slot (attestation_service.rs:125) --

@@ -1,0 +1,155 @@
+"""Stage-level device profile of the 128x1 verify kernel.
+
+The round-4 profile said ~590 ms of the 128-batch wall time is device
+execute, but scan-step microbenchmarks (scripts/microbench_fp.py) price the
+sequential arithmetic at single-digit milliseconds. This script times each
+pipeline stage as its OWN jitted program (real block_until_ready syncs) so
+the gap is attributable:
+
+  - if the stage times sum to ~the full-kernel time, some stage's math is
+    genuinely slow -> optimize that stage;
+  - if the stages are all fast but the fused full kernel is slow, the cost
+    is program-level (e.g. straight-line code blowing TPU instruction
+    memory) -> restructure into loops / split dispatches.
+
+Run: python scripts/profile_stages.py   (on the bench platform)
+"""
+
+import os
+import pathlib
+import statistics
+import sys
+import time
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(_ROOT))
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(_ROOT / ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
+
+N_SETS = int(os.environ.get("PROFILE_N_SETS", "128"))
+REPS = int(os.environ.get("PROFILE_REPS", "5"))
+
+
+def med(fn, reps=REPS):
+    fn()  # warm (compile)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return statistics.median(ts)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls.jax_backend import api as japi
+    from lighthouse_tpu.crypto.bls.jax_backend import h2c, pairing
+    from lighthouse_tpu.crypto.bls.jax_backend.curve import (
+        FP,
+        FP2,
+        Proj,
+        _stack2,
+        add as p_add,
+        eq_points,
+        from_affine,
+        is_infinity,
+        neg as p_neg,
+        psi,
+        scalar_mul_bits,
+        to_affine,
+    )
+    from lighthouse_tpu.crypto.bls.jax_backend.pack import G1_GEN_X_L, G1_GEN_NEG_Y_L
+    from jax import lax
+
+    b = bls.backend("jax")
+    pairs = [b.interop_keypair(i) for i in range(8)]
+    sets = []
+    for i in range(N_SETS):
+        sk, pk = pairs[i % 8]
+        msg = bytes([i % 8]) * 32
+        sets.append(b.SignatureSet(signature=sk.sign(msg), signing_keys=[pk], message=msg))
+
+    print(f"platform={jax.default_backend()} n_sets={N_SETS}", flush=True)
+    staged = japi.stage_sets(sets)
+    S, K = staged[2].shape
+    pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits = (jnp.asarray(a) for a in staged)
+    jax.block_until_ready(pk_x)
+
+    # -- stage 1: hash to G2 ---------------------------------------------------
+    h2g = jax.jit(lambda uu: h2c.hash_to_g2_device(uu))
+    t_h2c = med(lambda: jax.block_until_ready(h2g(u)))
+    print(f"stage h2c                 {t_h2c * 1e3:9.2f} ms", flush=True)
+    H = h2g(u)
+
+    # -- stage 2: ladders + folds (pipeline steps 2-5) -------------------------
+    def ladders(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, r_bits):
+        pks = from_affine(FP, pk_x, pk_y, pk_inf)
+        agg = Proj(pks.x[:, 0], pks.y[:, 0], pks.z[:, 0])
+        agg_inf = is_infinity(FP, agg)
+        r_pk = scalar_mul_bits(FP, agg, r_bits)
+        sigs = from_affine(FP2, sig_x, sig_y, sig_inf)
+        absx = jnp.broadcast_to(jnp.asarray(pairing._ABS_X_BITS_MSB[-64:]), r_bits.shape)
+        both = scalar_mul_bits(FP2, _stack2(FP2, sigs, sigs), jnp.stack([absx, r_bits]))
+        zsig = Proj(both.x[0], both.y[0], both.z[0])
+        rsig = Proj(both.x[1], both.y[1], both.z[1])
+        sub_ok = eq_points(FP2, psi(sigs), p_neg(FP2, zsig)) | is_infinity(FP2, sigs)
+
+        first = Proj(rsig.x[0], rsig.y[0], rsig.z[0])
+
+        def fold2(acc, nxt):
+            return p_add(FP2, acc, nxt), None
+
+        rest = Proj(rsig.x[1:], rsig.y[1:], rsig.z[1:])
+        sig_acc, _ = lax.scan(fold2, first, rest)
+        return r_pk, sig_acc, sub_ok, agg_inf
+
+    lad = jax.jit(ladders)
+    t_lad = med(
+        lambda: jax.block_until_ready(lad(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, r_bits))
+    )
+    print(f"stage ladders+folds       {t_lad * 1e3:9.2f} ms", flush=True)
+    r_pk, sig_acc, sub_ok, agg_inf = lad(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, r_bits)
+
+    # -- stage 3: to_affine + miller + product tree ----------------------------
+    def miller(r_pk, H, sig_acc):
+        pk_ax, pk_ay, pk_ainf = to_affine(FP, r_pk)
+        h_ax, h_ay, h_ainf = to_affine(FP2, H)
+        sa_x, sa_y, sa_inf = to_affine(FP2, sig_acc)
+        px = jnp.concatenate([pk_ax, jnp.asarray(G1_GEN_X_L)[None]], axis=0)
+        py = jnp.concatenate([pk_ay, jnp.asarray(G1_GEN_NEG_Y_L)[None]], axis=0)
+        p_in = jnp.concatenate([pk_ainf, jnp.zeros(1, bool)])
+        qx = jnp.concatenate([h_ax, sa_x[None]], axis=0)
+        qy = jnp.concatenate([h_ay, sa_y[None]], axis=0)
+        q_in = jnp.concatenate([h_ainf, sa_inf[None]])
+        f = pairing.miller_loop(px, py, p_in, qx, qy, q_in)
+        return pairing.product_reduce(f)
+
+    mil = jax.jit(miller)
+    t_mil = med(lambda: jax.block_until_ready(mil(r_pk, H, sig_acc)))
+    print(f"stage affine+miller+tree  {t_mil * 1e3:9.2f} ms", flush=True)
+    partial = mil(r_pk, H, sig_acc)
+
+    # -- stage 4: final exponentiation ----------------------------------------
+    fe = jax.jit(pairing.final_exponentiation)
+    t_fe = med(lambda: jax.block_until_ready(fe(partial)))
+    print(f"stage final_exp           {t_fe * 1e3:9.2f} ms", flush=True)
+
+    # -- full single-program kernel -------------------------------------------
+    flat = jnp.asarray(japi._pack_staged(staged))
+    kernel = japi._verify_kernel(S, K)
+    t_full = med(lambda: jax.block_until_ready(kernel(flat)))
+    print(f"full fused kernel         {t_full * 1e3:9.2f} ms", flush=True)
+    print(
+        f"sum of stages             {(t_h2c + t_lad + t_mil + t_fe) * 1e3:9.2f} ms",
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,323 @@
+"""Altair + bellatrix fork tests: containers, upgrades, participation
+accounting, sync aggregates, and cross-fork chains.
+
+Backend matrix follows the repo convention: structural tests on fake_crypto,
+cryptographic accept/reject tests on the ref oracle with small committees
+(/root/reference/Makefile:98-103 pattern). Reference behaviors mirrored:
+upgrade_to_altair (/root/reference/consensus/state_processing/src/upgrade/
+altair.rs), process_sync_aggregate (.../altair/sync_committee.rs), the
+altair epoch ordering (.../per_epoch_processing/altair/mod.rs).
+"""
+
+import dataclasses
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChainHarness
+from lighthouse_tpu.chain.beacon_chain import BlockError, empty_sync_aggregate
+from lighthouse_tpu.state_transition import (
+    BlockSignatureStrategy,
+    StateTransitionError,
+    TransitionContext,
+    interop_genesis_state,
+    process_slots,
+    upgrade_to_altair,
+)
+from lighthouse_tpu.state_transition.altair import (
+    get_next_sync_committee,
+    has_flag,
+    process_sync_committee_updates,
+)
+from lighthouse_tpu.state_transition.bellatrix import (
+    compute_timestamp_at_slot,
+    is_merge_transition_complete,
+    process_execution_payload,
+)
+from lighthouse_tpu.types import (
+    MINIMAL_PRESET,
+    MINIMAL_SPEC,
+    TIMELY_HEAD_FLAG_INDEX,
+    TIMELY_SOURCE_FLAG_INDEX,
+    TIMELY_TARGET_FLAG_INDEX,
+)
+from lighthouse_tpu.types.containers import minimal_types
+
+
+def ctx_with_forks(backend="fake", altair_epoch=None, bellatrix_epoch=None):
+    spec = MINIMAL_SPEC
+    if altair_epoch is not None:
+        spec = dataclasses.replace(spec, altair_fork_epoch=altair_epoch)
+    if bellatrix_epoch is not None:
+        spec = dataclasses.replace(spec, bellatrix_fork_epoch=bellatrix_epoch)
+    from lighthouse_tpu.crypto import bls as bls_pkg
+
+    return TransitionContext(minimal_types(), spec, bls_pkg.backend(backend))
+
+
+SLOTS = MINIMAL_PRESET.slots_per_epoch
+
+
+# -- containers ----------------------------------------------------------------
+
+
+def test_altair_state_roundtrip_with_content():
+    t = minimal_types()
+    st = t.BeaconStateAltair(
+        slot=9,
+        previous_epoch_participation=[1, 3, 7],
+        current_epoch_participation=[0, 2, 4],
+        inactivity_scores=[5, 0, 9],
+    )
+    data = t.BeaconStateAltair.serialize(st)
+    rt = t.BeaconStateAltair.deserialize(data)
+    assert rt == st
+    assert list(rt.inactivity_scores) == [5, 0, 9]
+    assert t.BeaconStateAltair.hash_tree_root(st) != t.BeaconStateAltair.hash_tree_root(
+        t.BeaconStateAltair()
+    )
+
+
+def test_fork_namespaces():
+    t = minimal_types()
+    assert t.fork_of(t.BeaconState()) == "phase0"
+    assert t.fork_of(t.BeaconStateAltair()) == "altair"
+    assert t.fork_of(t.BeaconBlockBodyBellatrix()) == "bellatrix"
+    assert t.for_fork("altair").SignedBeaconBlock is t.SignedBeaconBlockAltair
+
+
+def test_fork_aware_decode():
+    from lighthouse_tpu.types import decode_beacon_state, decode_signed_block
+
+    ctx = ctx_with_forks("fake", altair_epoch=0)
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    assert ctx.types.fork_of(state) == "altair"
+    data = type(state).serialize(state)
+    back = decode_beacon_state(data, ctx.types, ctx.spec)
+    assert type(back) is type(state)
+    sb = ctx.types.SignedBeaconBlockAltair(
+        message=ctx.types.BeaconBlockAltair(slot=3 * SLOTS)
+    )
+    blob = type(sb).serialize(sb)
+    back_b = decode_signed_block(blob, ctx.types, ctx.spec, ctx.preset)
+    assert type(back_b) is type(sb)
+
+
+# -- upgrade -------------------------------------------------------------------
+
+
+def test_upgrade_to_altair_shape():
+    ctx = ctx_with_forks("fake")
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    n = len(state.validators)
+    process_slots(state, 2 * SLOTS, ctx)  # past genesis so committees exist
+    upgrade_to_altair(state, ctx)
+    assert ctx.types.fork_of(state) == "altair"
+    assert bytes(state.fork.current_version) == ctx.spec.altair_fork_version
+    assert bytes(state.fork.previous_version) == ctx.spec.genesis_fork_version
+    assert len(state.previous_epoch_participation) == n
+    assert len(state.inactivity_scores) == n
+    assert len(state.current_sync_committee.pubkeys) == MINIMAL_PRESET.sync_committee_size
+    assert not hasattr(state, "previous_epoch_attestations")
+
+
+def test_scheduled_upgrade_applies_in_process_slots():
+    ctx = ctx_with_forks("fake", altair_epoch=2)
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    process_slots(state, 2 * SLOTS - 1, ctx)
+    assert ctx.types.fork_of(state) == "phase0"
+    process_slots(state, 2 * SLOTS, ctx)
+    assert ctx.types.fork_of(state) == "altair"
+    assert state.fork.epoch == 2
+
+
+def test_genesis_boots_into_scheduled_fork():
+    ctx = ctx_with_forks("fake", altair_epoch=0)
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    assert ctx.types.fork_of(state) == "altair"
+    ctx2 = ctx_with_forks("fake", altair_epoch=0, bellatrix_epoch=0)
+    state2 = interop_genesis_state(8, 1_600_000_000, ctx2)
+    assert ctx2.types.fork_of(state2) == "bellatrix"
+    assert not is_merge_transition_complete(state2)
+
+
+# -- chain on altair (fake backend) --------------------------------------------
+
+
+def test_finality_advances_altair(monkeypatch):
+    ctx = ctx_with_forks("fake", altair_epoch=0)
+    h = BeaconChainHarness(16, ctx)
+    h.extend_chain(4 * SLOTS)
+    assert h.justified_epoch() >= 2
+    assert h.finalized_epoch() >= 1
+    state = h.chain.head_state()
+    assert ctx.types.fork_of(state) == "altair"
+    # participation flags accrued for the previous epoch
+    assert any(
+        has_flag(f, TIMELY_SOURCE_FLAG_INDEX) and has_flag(f, TIMELY_TARGET_FLAG_INDEX)
+        for f in state.previous_epoch_participation
+    )
+    # sync + attestation rewards move balances upward on a healthy chain
+    assert any(b > ctx.spec.max_effective_balance for b in state.balances)
+
+
+def test_chain_crosses_fork_boundary(monkeypatch):
+    ctx = ctx_with_forks("fake", altair_epoch=1)
+    h = BeaconChainHarness(16, ctx)
+    h.extend_chain(3 * SLOTS)
+    state = h.chain.head_state()
+    assert ctx.types.fork_of(state) == "altair"
+    assert state.fork.epoch == 1
+    # blocks before the boundary were phase0, after it altair
+    roots = [h.chain.head_root]
+    blk = h.chain.store.get_block(h.chain.head_root)
+    assert ctx.types.fork_of(blk.message.body) == "altair"
+
+
+def test_sync_committee_rotation():
+    ctx = ctx_with_forks("fake", altair_epoch=0)
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    period = MINIMAL_PRESET.epochs_per_sync_committee_period
+    # place the state at the last epoch of a committee period
+    state.slot = (period - 1) * SLOTS
+    old_next = state.next_sync_committee
+    process_sync_committee_updates(state, ctx)
+    assert state.current_sync_committee is old_next
+    assert len(state.next_sync_committee.pubkeys) == MINIMAL_PRESET.sync_committee_size
+
+
+def test_inactivity_scores_grow_in_leak():
+    ctx = ctx_with_forks("fake", altair_epoch=0)
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    # no blocks/attestations at all: once finality delay exceeds
+    # MIN_EPOCHS_TO_INACTIVITY_PENALTY the chain is leaking and scores
+    # accumulate (outside a leak the recovery rate cancels the bias)
+    process_slots(state, 10 * SLOTS, ctx)
+    assert all(s > 0 for s in state.inactivity_scores)
+    balances_before = list(state.balances)
+    process_slots(state, 11 * SLOTS, ctx)
+    # leak penalties now bite
+    assert all(b < a for a, b in zip(balances_before, state.balances))
+
+
+# -- real-crypto altair (ref oracle, small) ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ref_altair_harness():
+    ctx = ctx_with_forks("ref", altair_epoch=0)
+    return BeaconChainHarness(8, ctx)
+
+
+def test_altair_blocks_bulk_verify_ref(ref_altair_harness):
+    h = ref_altair_harness
+    h.extend_chain(SLOTS + 2, strategy=BlockSignatureStrategy.VERIFY_BULK)
+    state = h.chain.head_state()
+    assert h.chain.ctx.types.fork_of(state) == "altair"
+
+
+def test_tampered_sync_aggregate_rejected_ref(ref_altair_harness):
+    h = ref_altair_harness
+    ctx = h.ctx
+    chain = h.chain
+    slot = chain.head_state().slot + 1
+    chain.slot_clock.set_slot(slot)
+    state = chain.state_at_slot(slot)
+    from lighthouse_tpu.state_transition.helpers import get_beacon_proposer_index
+
+    proposer = get_beacon_proposer_index(state, ctx.preset, ctx.spec)
+    reveal = h.randao_reveal(state, proposer, slot)
+    good = h.sync_aggregate_for_parent(state, slot)
+    # flip one participation bit without re-signing: aggregate no longer
+    # matches the claimed participant set
+    bits = list(good.sync_committee_bits)
+    bits[0] = not bits[0]
+    bad = ctx.types.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=good.sync_committee_signature,
+    )
+    block, _ = chain.produce_block_on_state(
+        state.copy(), slot, reveal, sync_aggregate=bad
+    )
+    signed = chain.sign_block(block, h._sk_for(proposer))
+    with pytest.raises(BlockError):
+        chain.process_block(signed, strategy=BlockSignatureStrategy.VERIFY_BULK)
+    # the untampered aggregate still lands
+    block2, _ = chain.produce_block_on_state(
+        state.copy(), slot, reveal, sync_aggregate=good
+    )
+    signed2 = chain.sign_block(block2, h._sk_for(proposer))
+    chain.process_block(signed2, strategy=BlockSignatureStrategy.VERIFY_BULK)
+
+
+def test_vc_proposes_and_attests_across_fork_boundary_ref():
+    """The VC signs with schedule-derived domains; at altair's first slot the
+    head state still carries the phase0 fork record, so state-derived domains
+    would make every proposal/attestation of the new epoch invalid (round-4
+    review finding)."""
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.validator_client.validator_client import (
+        BeaconNodeApi,
+        ValidatorClient,
+        ValidatorStore,
+    )
+
+    ctx = ctx_with_forks("ref", altair_epoch=1)
+    genesis = interop_genesis_state(8, 1_600_000_000, ctx)
+    chain = BeaconChain(genesis, ctx)
+    api = BeaconNodeApi(chain)
+    store = ValidatorStore(ctx)
+    for i in range(8):
+        sk, _ = ctx.bls.interop_keypair(i)
+        store.add_validator(sk)
+    vc = ValidatorClient(api, store)
+    for slot in range(SLOTS - 1, SLOTS + 2):  # last phase0 slot .. altair slots
+        chain.slot_clock.set_slot(slot)
+        summary = vc.on_slot(slot)
+        assert summary["proposed"] is not None, f"no block at slot {slot}"
+        assert summary["attested"] > 0, f"no attestations at slot {slot}"
+    assert ctx.types.fork_of(chain.head_state()) == "altair"
+
+
+# -- bellatrix -----------------------------------------------------------------
+
+
+def test_bellatrix_chain_pre_merge():
+    ctx = ctx_with_forks("fake", altair_epoch=0, bellatrix_epoch=1)
+    h = BeaconChainHarness(16, ctx)
+    h.extend_chain(2 * SLOTS)
+    state = h.chain.head_state()
+    assert ctx.types.fork_of(state) == "bellatrix"
+    assert not is_merge_transition_complete(state)
+
+
+def test_process_execution_payload_post_merge():
+    ctx = ctx_with_forks("fake", altair_epoch=0, bellatrix_epoch=0)
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    t = ctx.types
+    process_slots(state, 1, ctx)
+    # simulate a completed merge: non-default header in the state
+    state.latest_execution_payload_header = t.ExecutionPayloadHeader(
+        block_hash=b"\x11" * 32, block_number=7
+    )
+    from lighthouse_tpu.state_transition.helpers import get_current_epoch, get_randao_mix
+
+    payload = t.ExecutionPayload(
+        parent_hash=b"\x11" * 32,
+        prev_randao=get_randao_mix(state, get_current_epoch(state, ctx.preset), ctx.preset),
+        block_number=8,
+        timestamp=compute_timestamp_at_slot(state, state.slot, ctx),
+        block_hash=b"\x22" * 32,
+        transactions=[b"\x01\x02"],
+    )
+    process_execution_payload(state, payload, ctx)
+    assert bytes(state.latest_execution_payload_header.block_hash) == b"\x22" * 32
+    assert is_merge_transition_complete(state)
+    # wrong parent hash rejected
+    bad = t.ExecutionPayload(
+        parent_hash=b"\x33" * 32,
+        prev_randao=payload.prev_randao,
+        timestamp=payload.timestamp,
+        block_hash=b"\x44" * 32,
+    )
+    with pytest.raises(StateTransitionError):
+        process_execution_payload(state, bad, ctx)
