@@ -64,9 +64,12 @@ def batch_verify_gossip_attestations(chain, attestations, apply_to_fork_choice: 
                     else AttestationError("invalid signature")
                 )
 
-    if apply_to_fork_choice:
-        for i, indexed, _ in staged:
-            if results[i] is True:
+    for i, indexed, _ in staged:
+        if results[i] is True:
+            for obs in chain.attestation_observers:
+                for vi in indexed.attesting_indices:
+                    obs(int(vi), int(indexed.data.target.epoch))
+            if apply_to_fork_choice:
                 try:
                     chain.fork_choice.on_attestation(indexed)
                 except ForkChoiceError:

@@ -49,6 +49,10 @@ class BeaconChain:
         self.slot_clock = slot_clock if slot_clock is not None else ManualSlotClock()
         self.events = EventBus()
         self.validator_monitor = ValidatorMonitor()
+        # callables (validator_index, target_epoch) invoked for every
+        # attestation seen in imported blocks or accepted from gossip —
+        # the doppelganger service's liveness feed (doppelganger_service.rs)
+        self.attestation_observers: list = []
         self._last_finalized_epoch = 0
 
         t = ctx.types
@@ -115,6 +119,8 @@ class BeaconChain:
             indexed = get_indexed_attestation(state, att, t, self.ctx.preset, self.ctx.spec)
             for vi in indexed.attesting_indices:
                 self.validator_monitor.on_attestation_included(int(vi), int(att.data.slot))
+                for obs in self.attestation_observers:
+                    obs(int(vi), int(att.data.target.epoch))
             try:
                 self.fork_choice.on_attestation(indexed, is_from_block=True)
             except ForkChoiceError:

@@ -1,0 +1,156 @@
+"""Gossip over TCP: spec topic names, snappy-block payloads, spec message
+IDs, seen-cache dedup, and peer fan-out.
+
+The message-plane of /root/reference/beacon_node/lighthouse_network's
+gossipsub (behaviour/mod.rs + types/topics.rs:11-28 + the consensus p2p
+spec's message-id function):
+
+  - topic wire names: /eth2/{fork_digest}/{topic}/ssz_snappy
+  - payloads: snappy BLOCK-format compressed SSZ
+  - message id: SHA256(MESSAGE_DOMAIN_VALID_SNAPPY || uncompressed)[:20]
+  - dedup: bounded seen-cache keyed by message id; forwarding floods to all
+    connected peers except the sender (a full gossipsub mesh degenerates to
+    flooding at simulator scale; scoring/mesh-degree management is the
+    remaining delta, noted in NetworkService docs)
+
+Transport: persistent TCP connections between peers, one length-prefixed
+frame per message: varint(topic_len) || topic || payload.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import socket
+import threading
+from collections import OrderedDict
+
+from . import snappy as sn
+from .rpc import _read_exact, _recv_frame, _send_frame
+
+MESSAGE_DOMAIN_VALID_SNAPPY = b"\x01\x00\x00\x00"
+MAX_MESSAGE = 10 * 1024 * 1024
+SEEN_CACHE = 4096
+
+
+def message_id(uncompressed: bytes) -> bytes:
+    return hashlib.sha256(MESSAGE_DOMAIN_VALID_SNAPPY + uncompressed).digest()[:20]
+
+
+def encode_message(topic: str, ssz_bytes: bytes) -> bytes:
+    t = topic.encode()
+    return sn._uvarint_encode(len(t)) + t + sn.compress_block(ssz_bytes)
+
+
+def decode_message(frame: bytes) -> tuple[str, bytes]:
+    tlen, pos = sn._uvarint_decode(frame)
+    topic = frame[pos : pos + tlen].decode()
+    payload = sn.decompress_block(frame[pos + tlen :], max_output=MAX_MESSAGE)
+    return topic, payload
+
+
+class GossipNode:
+    """One node's gossip endpoint: a TCP listener + outbound peer links.
+
+    `deliver(topic_name, ssz_bytes)` is invoked (on a receiver thread) for
+    every novel message; `publish` floods to peers."""
+
+    def __init__(self, deliver, host: str = "127.0.0.1", port: int = 0):
+        self.deliver = deliver
+        # peer socket -> its send lock: sendall from several threads (a
+        # publish racing a forward) must not interleave frame bytes
+        self._peers: dict[socket.socket, threading.Lock] = {}
+        self._peers_lock = threading.Lock()
+        self._seen: OrderedDict[bytes, None] = OrderedDict()
+        self._seen_lock = threading.Lock()
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self.addr = self._listener.getsockname()
+        self._running = True
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # -- peering ---------------------------------------------------------------
+
+    def connect(self, addr) -> None:
+        sock = socket.create_connection(addr, timeout=10)
+        self._add_peer(sock)
+
+    def _add_peer(self, sock: socket.socket) -> None:
+        with self._peers_lock:
+            self._peers[sock] = threading.Lock()
+        threading.Thread(target=self._recv_loop, args=(sock,), daemon=True).start()
+
+    def _accept_loop(self) -> None:
+        while self._running:
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            self._add_peer(sock)
+
+    # -- wire ------------------------------------------------------------------
+
+    def _recv_loop(self, sock: socket.socket) -> None:
+        try:
+            while self._running:
+                frame = _recv_frame(sock, cap=MAX_MESSAGE)
+                self._on_frame(frame, source=sock)
+        except (ConnectionError, ValueError, OSError):
+            with self._peers_lock:
+                self._peers.pop(sock, None)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _mark_seen(self, mid: bytes) -> bool:
+        """True if novel (and marks it)."""
+        with self._seen_lock:
+            if mid in self._seen:
+                return False
+            self._seen[mid] = None
+            while len(self._seen) > SEEN_CACHE:
+                self._seen.popitem(last=False)
+            return True
+
+    def _on_frame(self, frame: bytes, source) -> None:
+        try:
+            topic, payload = decode_message(frame)
+        except (ValueError, UnicodeDecodeError):
+            return  # undecodable gossip drops (gossip_methods.rs rejects)
+        if not self._mark_seen(message_id(payload)):
+            return
+        self._forward(frame, exclude=source)
+        self.deliver(topic, payload)
+
+    def _forward(self, frame: bytes, exclude=None) -> None:
+        with self._peers_lock:
+            peers = [(p, lk) for p, lk in self._peers.items() if p is not exclude]
+        for p, lk in peers:
+            try:
+                with lk:
+                    _send_frame(p, frame)
+            except OSError:
+                pass  # dead peer reaped by its recv loop
+
+    # -- API -------------------------------------------------------------------
+
+    def publish(self, topic: str, ssz_bytes: bytes) -> None:
+        frame = encode_message(topic, ssz_bytes)
+        self._mark_seen(message_id(ssz_bytes))  # don't re-deliver to self
+        self._forward(frame)
+
+    def close(self) -> None:
+        self._running = False
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._peers_lock:
+            for p in self._peers:
+                try:
+                    p.close()
+                except OSError:
+                    pass
+            self._peers.clear()

@@ -1,0 +1,52 @@
+"""Naive aggregation pool for sync-committee messages.
+
+The role of the reference's naive_aggregation_pool for sync contributions
+(/root/reference/beacon_node/beacon_chain/src/naive_aggregation_pool.rs and
+sync_committee_verification.rs): per-(slot, block_root) accumulation of
+verified SyncCommitteeMessages into full-committee participation bits + an
+aggregate signature, from which block production lifts its SyncAggregate.
+
+A validator holding several committee positions contributes its signature
+once PER POSITION: verification aggregates the committee pubkey list by
+position, so the signature multiset must match the bit multiset.
+"""
+
+from __future__ import annotations
+
+
+class SyncMessagePool:
+    def __init__(self, ctx):
+        self.ctx = ctx
+        # (slot, block_root) -> [bits list, [decoded signatures]]
+        self._by_key: dict[tuple[int, bytes], list] = {}
+
+    def add(self, message, committee_positions: list[int]) -> None:
+        """Record a VERIFIED message occupying `committee_positions` of the
+        current sync committee."""
+        size = self.ctx.preset.sync_committee_size
+        key = (int(message.slot), bytes(message.beacon_block_root))
+        bits, sigs = self._by_key.setdefault(key, [[False] * size, []])
+        sig = self.ctx.bls.Signature.from_bytes(bytes(message.signature))
+        for pos in committee_positions:
+            if not bits[pos]:
+                bits[pos] = True
+                sigs.append(sig)
+
+    def get_sync_aggregate(self, slot: int, block_root: bytes):
+        """SyncAggregate for a block whose parent is `block_root` at `slot`
+        (the previous slot from the producing block's point of view)."""
+        from ..chain.beacon_chain import empty_sync_aggregate
+
+        t = self.ctx.types
+        entry = self._by_key.get((int(slot), bytes(block_root)))
+        if entry is None or not entry[1]:
+            return empty_sync_aggregate(t)
+        bits, sigs = entry
+        return t.SyncAggregate(
+            sync_committee_bits=list(bits),
+            sync_committee_signature=self.ctx.bls.aggregate_signatures(sigs).to_bytes(),
+        )
+
+    def prune(self, min_slot: int) -> None:
+        for key in [k for k in self._by_key if k[0] < min_slot]:
+            del self._by_key[key]

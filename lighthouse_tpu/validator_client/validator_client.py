@@ -99,14 +99,38 @@ class ValidatorStore:
         sd = SigningData(object_root=uint64.hash_tree_root(epoch), domain=domain)
         return self.keys[pubkey].sign(SigningData.hash_tree_root(sd)).to_bytes()
 
+    def sign_sync_committee_message(
+        self, pubkey: bytes, slot: int, block_root: bytes, state
+    ) -> bytes:
+        """Sync-committee duty signature over the head block root
+        (sync_committee_service.rs; verified by
+        signature_sets.sync_committee_message_signature_set)."""
+        from ..ssz.types import Bytes32
+
+        ctx = self.ctx
+        domain = schedule_domain(
+            ctx.spec,
+            ctx.spec.domain_sync_committee,
+            slot // ctx.preset.slots_per_epoch,
+            state.genesis_validators_root,
+        )
+        sd = SigningData(
+            object_root=Bytes32.hash_tree_root(bytes(block_root)), domain=domain
+        )
+        return self.keys[pubkey].sign(SigningData.hash_tree_root(sd)).to_bytes()
+
 
 class BeaconNodeApi:
     """In-process beacon-node surface (the role of common/eth2's
     BeaconNodeHttpClient + beacon_node/http_api endpoints the VC uses)."""
 
     def __init__(self, chain, op_pool: OperationPool | None = None):
+        from ..op_pool.sync_pool import SyncMessagePool
+
         self.chain = chain
         self.op_pool = op_pool or OperationPool(chain.ctx)
+        self.sync_pool = SyncMessagePool(chain.ctx)
+        self._sync_committee_cache: dict[int, list[bytes]] = {}
 
     # duties (http_api validator/duties/{attester,proposer})
     def attester_duties(self, epoch: int, pubkeys: list[bytes]) -> list[AttesterDuty]:
@@ -200,12 +224,88 @@ class BeaconNodeApi:
             self.op_pool.insert_attestation(attestation)
         return ok
 
+    # sync committee duties (validator/duties/sync + sync_committee pool)
+    def _sync_committee_for_message_slot(self, slot: int) -> list[bytes] | None:
+        """Pubkeys (by position) of the committee that will VERIFY messages
+        made at `slot`: the committee of the state at slot+1, where the
+        aggregating block lives. Using the head state's committee directly
+        would hand out the outgoing committee on the last slot of every
+        sync-committee period (the spec's slot+1 lookahead rule). Cached per
+        period — a period's current committee is fixed once it starts."""
+        ctx = self.chain.ctx
+        state = self.chain.head_state()
+        if ctx.types.fork_of(state) == "phase0":
+            return None
+        per_len = ctx.preset.epochs_per_sync_committee_period
+        period = compute_epoch_at_slot(slot + 1, ctx.preset) // per_len
+        cached = self._sync_committee_cache.get(period)
+        if cached is None:
+            head_period = compute_epoch_at_slot(state.slot, ctx.preset) // per_len
+            if period < head_period:
+                # a duty slot behind the head's period: state_at_slot cannot
+                # rewind, so the outgoing committee is unrecoverable here —
+                # no duties rather than wrong positions
+                return None
+            if period > head_period:
+                state = self.chain.state_at_slot(slot + 1)
+            cached = [bytes(pk) for pk in state.current_sync_committee.pubkeys]
+            self._sync_committee_cache = {
+                p: c for p, c in self._sync_committee_cache.items() if p + 2 > period
+            }
+            self._sync_committee_cache[period] = cached
+        return cached
+
+    def sync_duties(self, pubkeys: list[bytes], slot: int) -> dict[bytes, list[int]]:
+        """pubkey -> committee positions for messages made at `slot`
+        (empty dict on phase0)."""
+        committee = self._sync_committee_for_message_slot(slot)
+        if committee is None:
+            return {}
+        wanted = set(pubkeys)
+        out: dict[bytes, list[int]] = {}
+        for pos, pkb in enumerate(committee):
+            if pkb in wanted:
+                out.setdefault(pkb, []).append(pos)
+        return out
+
+    def publish_sync_message(self, message) -> bool:
+        """Verify a SyncCommitteeMessage against the head state and pool it
+        (sync_committee_verification.rs gossip admission, minus p2p)."""
+        from ..state_transition import signature_sets as sigsets
+        from ..state_transition.helpers import StateTransitionError
+
+        ctx = self.chain.ctx
+        state = self.chain.head_state()
+        if ctx.types.fork_of(state) == "phase0":
+            return False
+        try:
+            s = sigsets.sync_committee_message_signature_set(
+                state, message, ctx.bls, ctx.pubkeys.resolver(state), ctx.preset, ctx.spec
+            )
+        except StateTransitionError:
+            return False
+        if not ctx.bls.verify_signature_sets([s]):
+            return False
+        vk = bytes(state.validators[message.validator_index].pubkey)
+        positions = self.sync_duties([vk], int(message.slot)).get(vk)
+        if not positions:
+            return False
+        self.sync_pool.add(message, positions)
+        return True
+
     # block production/publish (validator/blocks + POST)
     def produce_block(self, slot: int, randao_reveal: bytes):
+        from ..types.containers import BeaconBlockHeader
+
         chain = self.chain
         state = chain.state_at_slot(slot)
         atts = self.op_pool.get_attestations(state)
         proposer, attester, exits = self.op_pool.get_slashings_and_exits(state)
+        sync_aggregate = None
+        if chain.ctx.types.fork_of(state) != "phase0":
+            # the block's sync aggregate covers the PREVIOUS slot's head
+            parent_root = BeaconBlockHeader.hash_tree_root(state.latest_block_header)
+            sync_aggregate = self.sync_pool.get_sync_aggregate(slot - 1, parent_root)
         block, _ = chain.produce_block_on_state(
             state,
             slot,
@@ -214,6 +314,7 @@ class BeaconNodeApi:
             proposer_slashings=proposer,
             attester_slashings=attester,
             exits=exits,
+            sync_aggregate=sync_aggregate,
         )
         return block
 
@@ -221,6 +322,7 @@ class BeaconNodeApi:
         self.chain.slot_clock.set_slot(max(self.chain.slot(), signed_block.message.slot))
         root = self.chain.process_block(signed_block)
         self.op_pool.prune(self.chain.store.get_state(root))
+        self.sync_pool.prune(int(signed_block.message.slot))
         return root
 
 
@@ -228,12 +330,46 @@ class ValidatorClient:
     """Drives duties for its validators each slot (the per-slot work of
     duties_service + attestation_service + block_service)."""
 
-    def __init__(self, api: BeaconNodeApi, store: ValidatorStore):
+    def __init__(self, api: BeaconNodeApi, store: ValidatorStore, doppelganger=None):
         self.api = api
         self.store = store
         self.ctx = store.ctx
+        self.doppelganger = doppelganger  # None -> protection disabled
         self._duty_cache: dict[int, list[AttesterDuty]] = {}
         self._proposer_cache: dict[int, dict[int, int]] = {}
+        self._doppelganger_registered = False
+        if doppelganger is not None:
+            # liveness feed: every attestation the BN sees (blocks + gossip)
+            api.chain.attestation_observers.append(self._observe_attestation)
+
+    def _observe_attestation(self, validator_index: int, epoch: int) -> None:
+        from .doppelganger import DoppelgangerDetected
+
+        try:
+            self.doppelganger.observe_attestation(validator_index, epoch)
+        except DoppelgangerDetected as e:
+            # signing stays disabled permanently (recorded in the service);
+            # a production deployment would also initiate shutdown here
+            # (doppelganger_service.rs shuts the whole VC down)
+            print(f"CRITICAL: {e}")
+
+    def _register_doppelganger(self, epoch: int) -> None:
+        """Register every managed validator on first duty tick (the watch
+        starts at VC startup, doppelganger_service.rs register_*)."""
+        if self.doppelganger is None or self._doppelganger_registered:
+            return
+        state = self.api.chain.head_state()
+        index_by_pk = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+        for pk in self.store.pubkeys():
+            vi = index_by_pk.get(pk)
+            if vi is not None:
+                self.doppelganger.register(vi, epoch)
+        self._doppelganger_registered = True
+
+    def _may_sign(self, validator_index: int, epoch: int) -> bool:
+        if self.doppelganger is None:
+            return True
+        return self.doppelganger.allows_signing(validator_index, epoch)
 
     def _duties_for_epoch(self, epoch: int) -> list[AttesterDuty]:
         if epoch not in self._duty_cache:
@@ -248,7 +384,8 @@ class ValidatorClient:
         summary {proposed: root|None, attested: n}."""
         ctx = self.ctx
         epoch = compute_epoch_at_slot(slot, ctx.preset)
-        summary = {"proposed": None, "attested": 0}
+        self._register_doppelganger(epoch)
+        summary = {"proposed": None, "attested": 0, "synced": 0}
 
         # -- block duty (block_service.rs) --
         if epoch not in self._proposer_cache:
@@ -258,7 +395,11 @@ class ValidatorClient:
         proposers = self._proposer_cache[epoch]
         proposer_index = proposers.get(slot)
         state = self.api.chain.head_state()
-        if proposer_index is not None and proposer_index < len(state.validators):
+        if (
+            proposer_index is not None
+            and proposer_index < len(state.validators)
+            and self._may_sign(proposer_index, epoch)
+        ):
             pk = bytes(state.validators[proposer_index].pubkey)
             if pk in self.store.keys:
                 reveal = self.store.sign_randao(pk, epoch, state)
@@ -278,6 +419,8 @@ class ValidatorClient:
         for ci, duties in sorted(by_committee.items()):
             data = self.api.attestation_data(slot, ci)
             for duty in duties:
+                if not self._may_sign(duty.validator_index, epoch):
+                    continue
                 pk = next(
                     (
                         pk
@@ -298,4 +441,20 @@ class ValidatorClient:
                 )
                 if self.api.publish_attestation(att):
                     summary["attested"] += 1
+
+        # -- sync committee duties (sync_committee_service.rs) --
+        head_root = self.api.chain.head_root
+        for pk, positions in self.api.sync_duties(self.store.pubkeys(), slot).items():
+            vi = index_by_pk.get(pk)
+            if vi is None or not self._may_sign(vi, epoch):
+                continue
+            sig = self.store.sign_sync_committee_message(pk, slot, head_root, head_state)
+            msg = ctx.types.SyncCommitteeMessage(
+                slot=slot,
+                beacon_block_root=head_root,
+                validator_index=vi,
+                signature=sig,
+            )
+            if self.api.publish_sync_message(msg):
+                summary["synced"] += 1
         return summary

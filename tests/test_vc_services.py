@@ -1,0 +1,216 @@
+"""VC auxiliary services: sync-committee duties, doppelganger protection,
+monitoring push.
+
+Reference behaviors: sync_committee_service.rs (messages -> pooled
+contributions -> SyncAggregate in the next block),
+doppelganger_service.rs:1-30 (watch a full epoch before signing),
+common/monitoring_api (beaconcha.in-style push records).
+"""
+
+import dataclasses
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from lighthouse_tpu.chain.beacon_chain import BeaconChain
+from lighthouse_tpu.common.monitoring import MonitoringService
+from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
+from lighthouse_tpu.types import MINIMAL_PRESET, MINIMAL_SPEC
+from lighthouse_tpu.types.containers import minimal_types
+from lighthouse_tpu.validator_client.doppelganger import (
+    DoppelgangerDetected,
+    DoppelgangerService,
+)
+from lighthouse_tpu.validator_client.validator_client import (
+    BeaconNodeApi,
+    ValidatorClient,
+    ValidatorStore,
+)
+from lighthouse_tpu.crypto import bls as bls_pkg
+
+SLOTS = MINIMAL_PRESET.slots_per_epoch
+
+
+def altair_vc(backend="ref", n=8, doppelganger=None):
+    spec = dataclasses.replace(MINIMAL_SPEC, altair_fork_epoch=0)
+    ctx = TransitionContext(minimal_types(), spec, bls_pkg.backend(backend))
+    genesis = interop_genesis_state(n, 1_600_000_000, ctx)
+    chain = BeaconChain(genesis, ctx)
+    api = BeaconNodeApi(chain)
+    store = ValidatorStore(ctx)
+    for i in range(n):
+        sk, _ = ctx.bls.interop_keypair(i)
+        store.add_validator(sk)
+    return ctx, chain, ValidatorClient(api, store, doppelganger=doppelganger)
+
+
+# -- sync committee service ----------------------------------------------------
+
+
+def test_vc_sync_messages_flow_into_next_block_ref():
+    ctx, chain, vc = altair_vc("ref")
+    s1 = vc.on_slot(1)
+    assert s1["proposed"] is not None
+    # every managed validator occupies >= 1 sync committee position
+    assert s1["synced"] > 0
+    s2 = vc.on_slot(2)
+    assert s2["proposed"] is not None
+    blk = chain.store.get_block(chain.head_root)
+    agg = blk.message.body.sync_aggregate
+    # the pooled messages from slot 1 became real participation at slot 2
+    assert any(agg.sync_committee_bits)
+    from lighthouse_tpu.crypto.bls.constants import G2_POINT_AT_INFINITY
+
+    assert bytes(agg.sync_committee_signature) != G2_POINT_AT_INFINITY
+
+
+def test_bad_sync_message_rejected_ref():
+    ctx, chain, vc = altair_vc("ref")
+    msg = ctx.types.SyncCommitteeMessage(
+        slot=1,
+        beacon_block_root=chain.head_root,
+        validator_index=0,
+        signature=b"\x11" * 96,
+    )
+    assert vc.api.publish_sync_message(msg) is False
+
+
+def test_sync_duties_use_next_slot_committee_at_period_boundary():
+    """Messages made at the LAST slot of a sync-committee period are
+    aggregated by the first block of the next period, which verifies against
+    the rotated committee — duties must come from the slot+1 state (spec
+    slot+1 lookahead; round-4 review finding)."""
+    ctx, chain, vc = altair_vc("fake")
+    period_slots = MINIMAL_PRESET.epochs_per_sync_committee_period * SLOTS
+    last = period_slots - 1
+    chain.slot_clock.set_slot(last)
+    rotated = chain.state_at_slot(period_slots).current_sync_committee
+    got = vc.api._sync_committee_for_message_slot(last)
+    assert got == [bytes(pk) for pk in rotated.pubkeys]
+    # one slot earlier the committee is still the un-rotated one
+    current = chain.head_state().current_sync_committee
+    assert vc.api._sync_committee_for_message_slot(last - 1) == [
+        bytes(pk) for pk in current.pubkeys
+    ]
+
+
+def test_doppelganger_detection_via_chain_observation():
+    """A foreign attestation by a watched validator, arriving through the
+    BN's gossip pipeline, must disable signing permanently."""
+    d = DoppelgangerService(detection_epochs=1)
+    ctx, chain, vc = altair_vc("fake", doppelganger=d)
+    vc.on_slot(1)  # registers watch at epoch 0; signs nothing (window active)
+    # a second instance of some validator attests in epoch 1 — a true
+    # doppelganger (registration-epoch messages are ignored as possibly our
+    # own pre-restart traffic); the BN sees it on gossip
+    from lighthouse_tpu.chain.attestation_processing import (
+        batch_verify_gossip_attestations,
+    )
+    from lighthouse_tpu.state_transition.helpers import get_beacon_committee
+    from lighthouse_tpu.types.containers import Checkpoint
+
+    state = chain.head_state()
+    committee = get_beacon_committee(state, SLOTS, 0, ctx.preset, ctx.spec)
+    data = ctx.types.AttestationData(
+        slot=SLOTS,
+        index=0,
+        beacon_block_root=chain.head_root,
+        source=state.current_justified_checkpoint,
+        target=Checkpoint(epoch=1, root=chain.head_root),
+    )
+    att = ctx.types.Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=data,
+        signature=b"\x00" * 96,
+    )
+    batch_verify_gossip_attestations(chain, [att])
+    assert d.detected(), "foreign attestation in the window must be detected"
+    detected_index = next(iter(d.detected()))
+    assert not d.allows_signing(detected_index, 100)
+
+
+# -- doppelganger --------------------------------------------------------------
+
+
+def test_doppelganger_blocks_signing_until_window_elapses():
+    d = DoppelgangerService(detection_epochs=1)
+    d.register(5, current_epoch=10)
+    assert not d.allows_signing(5, 10)  # registration epoch: still watching
+    assert not d.allows_signing(5, 11)  # first full epoch under watch
+    assert d.allows_signing(5, 12)
+    assert d.allows_signing(99, 10)  # unregistered: protection not enabled
+
+
+def test_doppelganger_detection_disables_permanently():
+    d = DoppelgangerService(detection_epochs=1)
+    d.register(5, current_epoch=10)
+    with pytest.raises(DoppelgangerDetected):
+        d.observe_attestation(5, epoch=11)
+    assert not d.allows_signing(5, 50)
+    assert d.detected() == {5: 11}
+    # observation after the window on a clean validator is benign
+    d.register(6, current_epoch=10)
+    d.observe_attestation(6, epoch=12)
+    assert d.allows_signing(6, 12)
+
+
+def test_vc_with_doppelganger_stays_silent_then_signs():
+    d = DoppelgangerService(detection_epochs=1)
+    for i in range(8):
+        d.register(i, current_epoch=0)
+    ctx, chain, vc = altair_vc("fake", doppelganger=d)
+    quiet = vc.on_slot(1)
+    assert quiet["proposed"] is None and quiet["attested"] == 0 and quiet["synced"] == 0
+    # window over at epoch 2
+    active = vc.on_slot(2 * SLOTS + 1)
+    assert active["proposed"] is not None
+    assert active["attested"] > 0
+
+
+# -- monitoring push -----------------------------------------------------------
+
+
+class _Capture(BaseHTTPRequestHandler):
+    received = []
+
+    def do_POST(self):
+        n = int(self.headers["Content-Length"])
+        _Capture.received.append(json.loads(self.rfile.read(n)))
+        self.send_response(200)
+        self.end_headers()
+
+    def log_message(self, *a):
+        pass
+
+
+def test_monitoring_push_roundtrip():
+    ctx, chain, vc = altair_vc("fake")
+    server = HTTPServer(("127.0.0.1", 0), _Capture)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    try:
+        mon = MonitoringService(
+            f"http://127.0.0.1:{server.server_port}/api/v1/client/metrics",
+            chain=chain,
+            validator_store=vc.store,
+            update_period=0,
+        )
+        assert mon.send() is True
+        assert mon.tick() is True  # period 0: always due
+    finally:
+        server.shutdown()
+    payload = _Capture.received[-1]
+    procs = {r["process"] for r in payload}
+    assert procs == {"beaconnode", "validator", "system"}
+    bn = next(r for r in payload if r["process"] == "beaconnode")
+    assert bn["client_name"] == "lighthouse_tpu"
+    val = next(r for r in payload if r["process"] == "validator")
+    assert val["validator_total"] == 8
+
+
+def test_monitoring_push_unreachable_is_swallowed():
+    mon = MonitoringService("http://127.0.0.1:1/nope", update_period=0)
+    assert mon.send() is False
+    assert mon.errors == 1
