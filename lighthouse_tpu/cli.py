@@ -140,6 +140,40 @@ def cmd_lcli(args) -> int:
     raise SystemExit(f"unknown lcli command {args.lcli_cmd}")
 
 
+def cmd_boot_node(args) -> int:
+    """Standalone discovery server (the lighthouse boot_node subcommand,
+    /root/reference/boot_node/src/lib.rs:1)."""
+    import pathlib
+    import time
+
+    from .network.discovery import DiscoveryService
+    from .network.enr import generate_key, private_key_from_bytes
+
+    if args.key_file and pathlib.Path(args.key_file).exists():
+        key = private_key_from_bytes(bytes.fromhex(pathlib.Path(args.key_file).read_text().strip()))
+    else:
+        key = generate_key()
+        if args.key_file:
+            raw = key.private_numbers().private_value.to_bytes(32, "big")
+            pathlib.Path(args.key_file).write_text(raw.hex())
+    svc = DiscoveryService(key, port=args.port, boot_mode=True)
+    text = svc.enr.to_text()
+    print(f"boot node listening on udp/{svc.addr[1]}")
+    print(f"enr: {text}")
+    if args.enr_file:
+        pathlib.Path(args.enr_file).write_text(text)
+    try:
+        deadline = time.time() + args.run_seconds if args.run_seconds else None
+        while deadline is None or time.time() < deadline:
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        svc.close()
+    print(f"peers learned: {len(svc.table)}")
+    return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     root = argparse.ArgumentParser(prog="lighthouse_tpu")
     sub = root.add_subparsers(dest="command", required=True)
@@ -175,6 +209,13 @@ def build_parser() -> argparse.ArgumentParser:
     vcr.add_argument("--keystore-password", required=True)
     vcr.add_argument("--output")
     am.set_defaults(fn=cmd_account_manager)
+
+    bo = sub.add_parser("boot-node", help="standalone discovery boot node")
+    bo.add_argument("--port", type=int, default=9000)
+    bo.add_argument("--key-file", help="32-byte hex secp256k1 key (generated if absent)")
+    bo.add_argument("--enr-file", help="write the textual ENR here")
+    bo.add_argument("--run-seconds", type=float, help="serve N seconds then exit (testing)")
+    bo.set_defaults(fn=cmd_boot_node)
 
     lc = sub.add_parser("lcli", help="dev tools")
     _add_common(lc)

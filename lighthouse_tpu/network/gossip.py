@@ -74,6 +74,10 @@ class GossipNode:
 
     def connect(self, addr) -> None:
         sock = socket.create_connection(addr, timeout=10)
+        # the connect timeout must not survive onto the long-lived link: a
+        # blocking recv() on an idle mesh would raise after 10 s and the
+        # recv loop would reap a healthy peer
+        sock.settimeout(None)
         self._add_peer(sock)
 
     def _add_peer(self, sock: socket.socket) -> None:

@@ -31,6 +31,7 @@ class SocketNetwork:
         self.ctx = ctx
         self._nodes: dict[str, dict] = {}
         self._lock = threading.Lock()
+        self._digest_cache: dict[bytes, set[bytes]] = {}
 
     # -- LocalNetwork interface ------------------------------------------------
 
@@ -111,12 +112,16 @@ class SocketNetwork:
         return decoder.deserialize(payload)
 
     def _valid_digests(self, chain) -> set[bytes]:
-        state = chain.head_state()
-        gvr = bytes(state.genesis_validators_root)
-        return {
-            compute_fork_digest(self.ctx.spec.fork_version(name), gvr)
-            for name in FORK_ORDER
-        }
+        # depends only on genesis_validators_root: compute once per chain
+        gvr = bytes(chain.head_state().genesis_validators_root)
+        cached = self._digest_cache.get(gvr)
+        if cached is None:
+            cached = {
+                compute_fork_digest(self.ctx.spec.fork_version(name), gvr)
+                for name in FORK_ORDER
+            }
+            self._digest_cache[gvr] = cached
+        return cached
 
     def _deliver(self, service, topic_name: str, payload: bytes) -> None:
         # /eth2/{digest}/{name}/ssz_snappy

@@ -337,7 +337,6 @@ class ValidatorClient:
         self.doppelganger = doppelganger  # None -> protection disabled
         self._duty_cache: dict[int, list[AttesterDuty]] = {}
         self._proposer_cache: dict[int, dict[int, int]] = {}
-        self._doppelganger_registered = False
         if doppelganger is not None:
             # liveness feed: every attestation the BN sees (blocks + gossip)
             api.chain.attestation_observers.append(self._observe_attestation)
@@ -354,9 +353,12 @@ class ValidatorClient:
             print(f"CRITICAL: {e}")
 
     def _register_doppelganger(self, epoch: int) -> None:
-        """Register every managed validator on first duty tick (the watch
-        starts at VC startup, doppelganger_service.rs register_*)."""
-        if self.doppelganger is None or self._doppelganger_registered:
+        """Register every managed validator each duty tick — register() is
+        idempotent (setdefault), and running per-tick means keys added to
+        the store mid-flight, or whose deposits activate later, still get a
+        watch window before their first signature
+        (doppelganger_service.rs register_*)."""
+        if self.doppelganger is None:
             return
         state = self.api.chain.head_state()
         index_by_pk = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
@@ -364,7 +366,6 @@ class ValidatorClient:
             vi = index_by_pk.get(pk)
             if vi is not None:
                 self.doppelganger.register(vi, epoch)
-        self._doppelganger_registered = True
 
     def _may_sign(self, validator_index: int, epoch: int) -> bool:
         if self.doppelganger is None:
