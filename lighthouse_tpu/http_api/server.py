@@ -183,6 +183,15 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     _data({"root": "0x" + type(state).hash_tree_root(state).hex()}),
                 )
+            elif parts[5] == "sync_committees":
+                if ctx.types.fork_of(state) == "phase0":
+                    raise ApiError(400, "state is pre-altair")
+                index_of = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+                validators = [
+                    str(index_of.get(bytes(pk), 0))
+                    for pk in state.current_sync_committee.pubkeys
+                ]
+                self._send(200, _data({"validators": validators}))
             else:
                 raise ApiError(404, "unknown state endpoint")
         elif len(parts) == 5 and parts[:4] == ["eth", "v1", "beacon", "headers"]:
@@ -251,8 +260,33 @@ class _Handler(BaseHTTPRequestHandler):
             block = api.produce_block(slot, reveal)
             self._send(
                 200,
-                json.dumps({"version": "phase0", "data": encode(block, t.BeaconBlock)}).encode(),
+                json.dumps(
+                    {
+                        "version": type(block.body).fork_name,
+                        "data": encode(block, type(block)),
+                    }
+                ).encode(),
             )
+        elif len(parts) == 5 and parts[:4] == ["eth", "v2", "beacon", "blocks"]:
+            # fork-versioned block envelope (the v2 block endpoint)
+            root = (
+                self.chain.head_root
+                if parts[4] == "head"
+                else _parse_root(parts[4], "block id")
+            )
+            signed = self.chain.store.get_block(root)
+            if signed is None:
+                raise ApiError(404, "block not found")
+            self._send(
+                200,
+                json.dumps(
+                    {
+                        "version": type(signed.message.body).fork_name,
+                        "data": encode(signed, type(signed)),
+                    }
+                ).encode(),
+            )
+
         else:
             raise ApiError(404, "unknown endpoint")
 
@@ -325,9 +359,53 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send(200, b"{}")
         elif parts == ["eth", "v1", "beacon", "blocks"]:
-            signed = decode(body, t.SignedBeaconBlock)
+            slot = int(body["message"]["slot"])
+            fork = ctx.spec.fork_name_at_epoch(slot // ctx.preset.slots_per_epoch)
+            signed = decode(body, t.for_fork(fork).SignedBeaconBlock)
             root = api.publish_block(signed)
             self._send(200, json.dumps({"data": {"root": "0x" + root.hex()}}).encode())
+        elif parts == ["eth", "v1", "beacon", "pool", "sync_committees"]:
+            failures = []
+            for i, obj in enumerate(body):
+                msg = decode(obj, t.SyncCommitteeMessage)
+                if not api.publish_sync_message(msg):
+                    failures.append({"index": i, "message": "sync message rejected"})
+            if failures:
+                self._send(
+                    400,
+                    json.dumps(
+                        {"code": 400, "message": "some messages failed", "failures": failures}
+                    ).encode(),
+                )
+            else:
+                self._send(200, b"{}")
+        elif len(parts) == 6 and parts[:5] == ["eth", "v1", "validator", "duties", "sync"]:
+            epoch = int(parts[5])
+            state = self.chain.head_state()
+            indices = [int(i) for i in body]
+            pubkeys = [
+                bytes(state.validators[i].pubkey)
+                for i in indices
+                if i < len(state.validators)
+            ]
+            # duties for the REQUESTED epoch (period lookahead), not the
+            # current slot: the committee serving that epoch's first slot
+            duty_slot = epoch * ctx.preset.slots_per_epoch
+            duties = api.sync_duties(pubkeys, max(duty_slot, int(state.slot)))
+            index_of = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+            self._send(
+                200,
+                _data(
+                    [
+                        {
+                            "pubkey": "0x" + pk.hex(),
+                            "validator_index": str(index_of[pk]),
+                            "validator_sync_committee_indices": [str(p) for p in positions],
+                        }
+                        for pk, positions in sorted(duties.items())
+                    ]
+                ),
+            )
         elif len(parts) == 6 and parts[:5] == ["eth", "v1", "validator", "duties", "attester"]:
             epoch = int(parts[5])
             indices = [int(i) for i in body]

@@ -50,12 +50,32 @@ class ValidatorStore:
 
     def __init__(self, ctx, slashing_db: SlashingDatabase | None = None):
         self.ctx = ctx
-        self.keys = {}  # pubkey bytes -> SecretKey
+        self.keys = {}  # pubkey bytes -> SecretKey | web3signer.RemoteKey
         self.slashing_db = slashing_db or SlashingDatabase()
+
+    def _key_for(self, pubkey: bytes, duty_type: str):
+        """The signing key, stamped with the duty type when remote (the
+        Web3Signer request's "type" field; local keys ignore it)."""
+        key = self.keys[pubkey]
+        if hasattr(key, "set_duty"):
+            key.set_duty(duty_type)
+        return key
 
     def add_validator(self, secret_key) -> bytes:
         pk = secret_key.public_key().to_bytes()
         self.keys[pk] = secret_key
+        self.slashing_db.register_validator(pk)
+        return pk
+
+    def add_web3signer_validator(self, pubkey: bytes, client) -> bytes:
+        """Register a key whose secret lives in a remote Web3Signer
+        (signing_method.rs SigningMethod::Web3Signer): the RemoteKey carries
+        the same sign(root) shape local SecretKeys have, so every duty path
+        and the slashing DB work identically."""
+        from .web3signer import RemoteKey
+
+        pk = bytes(pubkey)
+        self.keys[pk] = RemoteKey(pk, client)
         self.slashing_db.register_validator(pk)
         return pk
 
@@ -75,7 +95,7 @@ class ValidatorStore:
         )
         root = compute_signing_root(block, domain)
         self.slashing_db.check_and_insert_block_proposal(pubkey, block.slot, root)
-        return self.keys[pubkey].sign(root).to_bytes()
+        return self._key_for(pubkey, "BLOCK_V2").sign(root).to_bytes()
 
     def sign_attestation(self, pubkey: bytes, data, state) -> bytes:
         ctx = self.ctx
@@ -89,7 +109,7 @@ class ValidatorStore:
         self.slashing_db.check_and_insert_attestation(
             pubkey, data.source.epoch, data.target.epoch, root
         )
-        return self.keys[pubkey].sign(root).to_bytes()
+        return self._key_for(pubkey, "ATTESTATION").sign(root).to_bytes()
 
     def sign_randao(self, pubkey: bytes, epoch: int, state) -> bytes:
         ctx = self.ctx
@@ -97,7 +117,36 @@ class ValidatorStore:
             ctx.spec, ctx.spec.domain_randao, epoch, state.genesis_validators_root
         )
         sd = SigningData(object_root=uint64.hash_tree_root(epoch), domain=domain)
-        return self.keys[pubkey].sign(SigningData.hash_tree_root(sd)).to_bytes()
+        return self._key_for(pubkey, "RANDAO_REVEAL").sign(
+            SigningData.hash_tree_root(sd)
+        ).to_bytes()
+
+    def sign_selection_proof(self, pubkey: bytes, slot: int, state) -> bytes:
+        """Aggregation-slot selection proof (signing_method.rs
+        SignableMessage::SelectionProof): the slot under
+        DOMAIN_SELECTION_PROOF; its hash decides aggregator duty."""
+        ctx = self.ctx
+        domain = schedule_domain(
+            ctx.spec,
+            ctx.spec.domain_selection_proof,
+            slot // ctx.preset.slots_per_epoch,
+            state.genesis_validators_root,
+        )
+        sd = SigningData(object_root=uint64.hash_tree_root(slot), domain=domain)
+        return self._key_for(pubkey, "AGGREGATION_SLOT").sign(
+            SigningData.hash_tree_root(sd)
+        ).to_bytes()
+
+    def sign_aggregate_and_proof(self, pubkey: bytes, message, state) -> bytes:
+        ctx = self.ctx
+        domain = schedule_domain(
+            ctx.spec,
+            ctx.spec.domain_aggregate_and_proof,
+            int(message.aggregate.data.slot) // ctx.preset.slots_per_epoch,
+            state.genesis_validators_root,
+        )
+        root = compute_signing_root(message, domain)
+        return self._key_for(pubkey, "AGGREGATE_AND_PROOF").sign(root).to_bytes()
 
     def sign_sync_committee_message(
         self, pubkey: bytes, slot: int, block_root: bytes, state
@@ -117,7 +166,9 @@ class ValidatorStore:
         sd = SigningData(
             object_root=Bytes32.hash_tree_root(bytes(block_root)), domain=domain
         )
-        return self.keys[pubkey].sign(SigningData.hash_tree_root(sd)).to_bytes()
+        return self._key_for(pubkey, "SYNC_COMMITTEE_MESSAGE").sign(
+            SigningData.hash_tree_root(sd)
+        ).to_bytes()
 
 
 class BeaconNodeApi:
@@ -224,6 +275,75 @@ class BeaconNodeApi:
             self.op_pool.insert_attestation(attestation)
         return ok
 
+    # aggregation (validator/aggregate_attestation + aggregate_and_proofs)
+    def get_aggregate(self, slot: int, committee_index: int):
+        """Best pooled aggregate for (slot, index) — the naive aggregation
+        pool read (beacon_chain.rs get_aggregated_attestation)."""
+        best = None
+        for bucket in self.op_pool.attestations.values():
+            for att in bucket:
+                if int(att.data.slot) == slot and int(att.data.index) == committee_index:
+                    if best is None or sum(att.aggregation_bits) > sum(best.aggregation_bits):
+                        best = att
+        return best
+
+    def publish_aggregate(self, signed_aggregate) -> bool:
+        """Admit a SignedAggregateAndProof: ONE batched backend call covers
+        the selection proof, the outer aggregator signature, and the inner
+        aggregate (attestation_verification.rs's three-set admission)."""
+        from ..state_transition import signature_sets as sigsets
+        from ..state_transition.helpers import (
+            StateTransitionError,
+            get_beacon_committee,
+            get_indexed_attestation,
+        )
+
+        ctx = self.chain.ctx
+        state = self.chain.head_state()
+        msg = signed_aggregate.message
+        att = msg.aggregate
+        resolver = ctx.pubkeys.resolver(state)
+        try:
+            committee = get_beacon_committee(
+                state, int(att.data.slot), int(att.data.index), ctx.preset, ctx.spec
+            )
+            if int(msg.aggregator_index) not in committee:
+                return False
+            # the proof must actually SELECT this validator (the reference's
+            # InvalidSelectionProof admission check) — a valid signature that
+            # hashes to a non-zero modulo is still not an aggregator
+            if not is_aggregator(len(committee), bytes(msg.selection_proof)):
+                return False
+            sets = [
+                sigsets.selection_proof_signature_set(
+                    state,
+                    int(att.data.slot),
+                    int(msg.aggregator_index),
+                    msg.selection_proof,
+                    ctx.bls,
+                    resolver,
+                    ctx.preset,
+                    ctx.spec,
+                ),
+                sigsets.aggregate_and_proof_signature_set(
+                    state, signed_aggregate, ctx.bls, resolver, ctx.preset, ctx.spec
+                ),
+                sigsets.indexed_attestation_signature_set(
+                    state,
+                    get_indexed_attestation(state, att, ctx.types, ctx.preset, ctx.spec),
+                    ctx.bls,
+                    resolver,
+                    ctx.preset,
+                    ctx.spec,
+                ),
+            ]
+        except StateTransitionError:
+            return False
+        if not ctx.bls.verify_signature_sets(sets):
+            return False
+        self.op_pool.insert_attestation(att)
+        return True
+
     # sync committee duties (validator/duties/sync + sync_committee pool)
     def _sync_committee_for_message_slot(self, slot: int) -> list[bytes] | None:
         """Pubkeys (by position) of the committee that will VERIFY messages
@@ -326,6 +446,19 @@ class BeaconNodeApi:
         return root
 
 
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+
+
+def is_aggregator(committee_length: int, selection_proof: bytes) -> bool:
+    """Spec is_aggregator: hash of the selection proof picks ~16 aggregators
+    per committee (attestation_service.rs:125-230's slot+2/3 duty)."""
+    import hashlib
+
+    modulo = max(1, committee_length // TARGET_AGGREGATORS_PER_COMMITTEE)
+    digest = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0
+
+
 class ValidatorClient:
     """Drives duties for its validators each slot (the per-slot work of
     duties_service + attestation_service + block_service)."""
@@ -386,7 +519,7 @@ class ValidatorClient:
         ctx = self.ctx
         epoch = compute_epoch_at_slot(slot, ctx.preset)
         self._register_doppelganger(epoch)
-        summary = {"proposed": None, "attested": 0, "synced": 0}
+        summary = {"proposed": None, "attested": 0, "synced": 0, "aggregated": 0}
 
         # -- block duty (block_service.rs) --
         if epoch not in self._proposer_cache:
@@ -442,6 +575,35 @@ class ValidatorClient:
                 )
                 if self.api.publish_attestation(att):
                     summary["attested"] += 1
+
+        # -- aggregation duty (attestation_service.rs slot+2/3 aggregates) --
+        pk_by_index = {
+            vi: pk for pk, vi in index_by_pk.items() if pk in self.store.keys
+        }
+        for ci, duties in sorted(by_committee.items()):
+            aggregate = self.api.get_aggregate(slot, ci)  # one pool scan per ci
+            if aggregate is None:
+                continue
+            for duty in duties:
+                if not self._may_sign(duty.validator_index, epoch):
+                    continue
+                pk = pk_by_index.get(duty.validator_index)
+                if pk is None:
+                    continue
+                proof = self.store.sign_selection_proof(pk, slot, head_state)
+                if not is_aggregator(duty.committee_length, proof):
+                    continue
+                message = ctx.types.AggregateAndProof(
+                    aggregator_index=duty.validator_index,
+                    aggregate=aggregate,
+                    selection_proof=proof,
+                )
+                signed = ctx.types.SignedAggregateAndProof(
+                    message=message,
+                    signature=self.store.sign_aggregate_and_proof(pk, message, head_state),
+                )
+                if self.api.publish_aggregate(signed):
+                    summary["aggregated"] += 1
 
         # -- sync committee duties (sync_committee_service.rs) --
         head_root = self.api.chain.head_root

@@ -1,0 +1,130 @@
+"""Fork-versioned HTTP API: altair block envelopes, sync-committee duties
+and message pool over the wire.
+
+Mirrors the Eth2 API's fork-aware surfaces the VC needs on an altair
+network (v2 block endpoints with version tags, duties/sync, the
+sync_committees state resource, and the sync message pool POST)."""
+
+import dataclasses
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChain
+from lighthouse_tpu.http_api import HttpApiServer, decode, encode
+from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
+from lighthouse_tpu.types import MINIMAL_PRESET, MINIMAL_SPEC
+from lighthouse_tpu.types.containers import minimal_types
+from lighthouse_tpu.validator_client import BeaconNodeApi, ValidatorClient, ValidatorStore
+from lighthouse_tpu.crypto import bls as bls_pkg
+
+
+@pytest.fixture(scope="module")
+def altair_server():
+    ctx = TransitionContext(
+        minimal_types(),
+        dataclasses.replace(MINIMAL_SPEC, altair_fork_epoch=0),
+        bls_pkg.backend("ref"),
+    )
+    genesis = interop_genesis_state(8, 1_600_000_000, ctx)
+    chain = BeaconChain(genesis, ctx)
+    api = BeaconNodeApi(chain)
+    store = ValidatorStore(ctx)
+    for i in range(8):
+        sk, _ = ctx.bls.interop_keypair(i)
+        store.add_validator(sk)
+    vc = ValidatorClient(api, store)
+    chain.slot_clock.set_slot(1)
+    assert vc.on_slot(1)["proposed"] is not None
+    srv = HttpApiServer(api).start()
+    yield ctx, chain, vc, srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}") as r:
+        return r.status, json.loads(r.read() or b"null")
+
+
+def _post(srv, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read() or b"null")
+
+
+def test_v2_block_envelope_carries_fork_version(altair_server):
+    ctx, chain, vc, srv = altair_server
+    status, resp = _get(srv, "/eth/v2/beacon/blocks/head")
+    assert status == 200
+    assert resp["version"] == "altair"
+    blk = decode(resp["data"], ctx.types.SignedBeaconBlockAltair)
+    assert type(blk.message).hash_tree_root(blk.message) == chain.head_root
+    assert "sync_aggregate" in resp["data"]["message"]["body"]
+
+
+def test_block_production_and_publish_roundtrip_altair(altair_server):
+    ctx, chain, vc, srv = altair_server
+    slot = int(chain.head_state().slot) + 1
+    chain.slot_clock.set_slot(slot)
+    state = chain.head_state()
+    from lighthouse_tpu.state_transition.helpers import get_beacon_proposer_index
+
+    adv = chain.state_at_slot(slot)
+    proposer = get_beacon_proposer_index(adv, ctx.preset, ctx.spec)
+    pk = bytes(state.validators[proposer].pubkey)
+    reveal = vc.store.sign_randao(pk, slot // ctx.preset.slots_per_epoch, state)
+    status, resp = _get(srv, f"/eth/v2/validator/blocks/{slot}?randao_reveal=0x{reveal.hex()}")
+    assert status == 200 and resp["version"] == "altair"
+    block = decode(resp["data"], ctx.types.BeaconBlockAltair)
+    sig = vc.store.sign_block(pk, block, state)
+    signed = ctx.types.SignedBeaconBlockAltair(message=block, signature=sig)
+    status, out = _post(srv, "/eth/v1/beacon/blocks", encode(signed, type(signed)))
+    assert status == 200
+    assert bytes.fromhex(out["data"]["root"].removeprefix("0x")) == chain.head_root
+
+
+def test_sync_duties_and_message_pool(altair_server):
+    ctx, chain, vc, srv = altair_server
+    status, resp = _post(srv, "/eth/v1/validator/duties/sync/0", [str(i) for i in range(8)])
+    assert status == 200
+    duties = resp["data"]
+    assert duties, "every interop validator should hold sync positions"
+    total_positions = sum(len(d["validator_sync_committee_indices"]) for d in duties)
+    assert total_positions == MINIMAL_PRESET.sync_committee_size
+
+    # sign and POST a sync message for the first duty
+    d0 = duties[0]
+    pk = bytes.fromhex(d0["pubkey"].removeprefix("0x"))
+    slot = int(chain.head_state().slot)
+    head = chain.head_root
+    sig = vc.store.sign_sync_committee_message(pk, slot, head, chain.head_state())
+    msg = ctx.types.SyncCommitteeMessage(
+        slot=slot,
+        beacon_block_root=head,
+        validator_index=int(d0["validator_index"]),
+        signature=sig,
+    )
+    status, _ = _post(srv, "/eth/v1/beacon/pool/sync_committees", [encode(msg, type(msg))])
+    assert status == 200
+
+    # a garbage signature is rejected with failures listed
+    bad = ctx.types.SyncCommitteeMessage(
+        slot=slot, beacon_block_root=head, validator_index=0, signature=b"\x22" * 96
+    )
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _post(srv, "/eth/v1/beacon/pool/sync_committees", [encode(bad, type(bad))])
+    assert exc.value.code == 400
+
+
+def test_sync_committees_state_resource(altair_server):
+    ctx, chain, vc, srv = altair_server
+    status, resp = _get(srv, "/eth/v1/beacon/states/head/sync_committees")
+    assert status == 200
+    assert len(resp["data"]["validators"]) == MINIMAL_PRESET.sync_committee_size

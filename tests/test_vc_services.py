@@ -131,6 +131,56 @@ def test_doppelganger_detection_via_chain_observation():
     assert not d.allows_signing(detected_index, 100)
 
 
+# -- aggregation duty ----------------------------------------------------------
+
+
+def test_aggregation_duty_produces_verified_aggregates_ref():
+    ctx, chain, vc = altair_vc("ref")
+    chain.slot_clock.set_slot(1)
+    s = vc.on_slot(1)
+    assert s["attested"] > 0
+    assert s["aggregated"] > 0  # minimal committees: everyone aggregates
+    # the pool now holds the aggregate the duty published
+    agg = vc.api.get_aggregate(1, 0)
+    assert agg is not None
+
+    # a forged aggregate-and-proof (wrong aggregator signature) is refused
+    from lighthouse_tpu.state_transition.helpers import get_beacon_committee
+
+    state = chain.head_state()
+    committee = get_beacon_committee(state, 1, 0, ctx.preset, ctx.spec)
+    pk = bytes(state.validators[committee[0]].pubkey)
+    proof = vc.store.sign_selection_proof(pk, 1, state)
+    msg = ctx.types.AggregateAndProof(
+        aggregator_index=committee[0], aggregate=agg, selection_proof=proof
+    )
+    forged = ctx.types.SignedAggregateAndProof(message=msg, signature=b"\x13" * 96)
+    assert vc.api.publish_aggregate(forged) is False
+    # non-committee aggregator index is refused outright
+    outsider = next(i for i in range(len(state.validators)) if i not in committee)
+    msg2 = ctx.types.AggregateAndProof(
+        aggregator_index=outsider, aggregate=agg, selection_proof=proof
+    )
+    signed2 = ctx.types.SignedAggregateAndProof(
+        message=msg2,
+        signature=vc.store.sign_aggregate_and_proof(
+            bytes(state.validators[outsider].pubkey), msg2, state
+        ),
+    )
+    assert vc.api.publish_aggregate(signed2) is False
+
+
+def test_is_aggregator_selects_subset():
+    from lighthouse_tpu.validator_client.validator_client import is_aggregator
+
+    hits = sum(
+        1 for i in range(256) if is_aggregator(256, i.to_bytes(2, "big") * 48)
+    )
+    # modulo 16: ~1/16 of proofs select; allow generous slack
+    assert 4 <= hits <= 48
+    assert is_aggregator(4, b"\x00" * 96)  # small committees: everyone
+
+
 # -- doppelganger --------------------------------------------------------------
 
 
