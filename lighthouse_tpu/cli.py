@@ -19,6 +19,19 @@ def _add_common(p: argparse.ArgumentParser) -> None:
     p.add_argument("--bls-backend", choices=["ref", "fake", "jax"], default="ref")
 
 
+def _parse_jwt_secret(hex_str: str | None) -> bytes | None:
+    if hex_str is None:
+        return None
+    raw = hex_str.removeprefix("0x")
+    try:
+        secret = bytes.fromhex(raw)
+    except ValueError:
+        raise SystemExit("--execution-jwt must be hex") from None
+    if len(secret) != 32:
+        raise SystemExit(f"--execution-jwt must decode to 32 bytes (got {len(secret)})")
+    return secret
+
+
 def cmd_beacon_node(args) -> int:
     from .client import Client, ClientConfig
 
@@ -31,6 +44,8 @@ def cmd_beacon_node(args) -> int:
         interop_validators=args.interop_validators,
         genesis_time=args.genesis_time or int(time.time()),
         checkpoint_url=args.checkpoint_sync_url,
+        execution_endpoints=list(args.execution_endpoint),
+        jwt_secret=_parse_jwt_secret(args.execution_jwt),
     )
     client = Client(cfg)
     print(f"beacon node up: preset={args.preset} bls={args.bls_backend}")
@@ -186,6 +201,8 @@ def build_parser() -> argparse.ArgumentParser:
     bn.add_argument("--interop-validators", type=int, default=16)
     bn.add_argument("--genesis-time", type=int)
     bn.add_argument("--checkpoint-sync-url", help="boot from a trusted node's finalized state")
+    bn.add_argument("--execution-endpoint", action="append", default=[], help="engine API URL (repeatable)")
+    bn.add_argument("--execution-jwt", help="hex-encoded 32-byte engine JWT secret")
     bn.add_argument("--run-slots", type=int, help="run N slots then exit (testing)")
     bn.set_defaults(fn=cmd_beacon_node)
 

@@ -37,6 +37,9 @@ class ClientConfig:
     # checkpoint sync: boot from a trusted node's finalized state
     # (ClientGenesis::CheckpointSyncUrl, client/src/builder.rs:264-330)
     checkpoint_url: str | None = None
+    # execution layer (bellatrix): engine endpoints + shared JWT secret
+    execution_endpoints: list = field(default_factory=list)
+    jwt_secret: bytes | None = None
 
 
 class Client:
@@ -50,6 +53,16 @@ class Client:
             else TransitionContext.mainnet(config.bls_backend)
         )
         self.ctx = ctx
+
+        if config.execution_endpoints:
+            from .execution_layer import EngineApiClient, ExecutionLayer
+
+            ctx.execution_engine = ExecutionLayer(
+                [
+                    EngineApiClient(url, jwt_secret=config.jwt_secret)
+                    for url in config.execution_endpoints
+                ]
+            )
 
         if config.datadir:
             store = HotColdDB(

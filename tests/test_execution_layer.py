@@ -1,0 +1,144 @@
+"""Execution layer: JWT auth, Engine-API round-trips, engine fallback,
+payload invalidation through the state transition.
+
+Mirrors /root/reference/beacon_node/execution_layer (engine_api/http.rs
+transport + auth, engines.rs fallback) and the fault-injection patterns of
+beacon_chain/tests/payload_invalidation.rs."""
+
+import dataclasses
+
+import pytest
+
+from lighthouse_tpu.execution_layer import (
+    EngineApiClient,
+    EngineApiError,
+    ExecutionLayer,
+    MockExecutionEngine,
+    PayloadStatus,
+    jwt_token,
+)
+from lighthouse_tpu.state_transition import (
+    StateTransitionError,
+    TransitionContext,
+    interop_genesis_state,
+    process_slots,
+)
+from lighthouse_tpu.state_transition.bellatrix import (
+    compute_timestamp_at_slot,
+    process_execution_payload,
+)
+from lighthouse_tpu.types import MINIMAL_SPEC
+from lighthouse_tpu.types.containers import minimal_types
+from lighthouse_tpu.crypto import bls as bls_pkg
+
+SECRET = b"\x42" * 32
+
+
+@pytest.fixture()
+def engine():
+    el = MockExecutionEngine(jwt_secret=SECRET).start()
+    yield el
+    el.stop()
+
+
+def bellatrix_ctx(execution_engine=None):
+    ctx = TransitionContext(
+        minimal_types(),
+        dataclasses.replace(MINIMAL_SPEC, altair_fork_epoch=0, bellatrix_fork_epoch=0),
+        bls_pkg.backend("fake"),
+    )
+    ctx.execution_engine = execution_engine
+    return ctx
+
+
+def make_payload(ctx, state):
+    from lighthouse_tpu.state_transition.helpers import get_current_epoch, get_randao_mix
+
+    return ctx.types.ExecutionPayload(
+        parent_hash=b"\x11" * 32,
+        prev_randao=get_randao_mix(state, get_current_epoch(state, ctx.preset), ctx.preset),
+        block_number=8,
+        timestamp=compute_timestamp_at_slot(state, state.slot, ctx),
+        block_hash=b"\x22" * 32,
+        transactions=[b"\xaa\xbb"],
+    )
+
+
+def test_jwt_shape_and_auth(engine):
+    token = jwt_token(SECRET)
+    assert token.count(".") == 2
+    good = EngineApiClient(engine.url, jwt_secret=SECRET)
+    assert good.upcheck()
+    bad = EngineApiClient(engine.url, jwt_secret=b"\x00" * 32)
+    assert not bad.upcheck()
+    anon = EngineApiClient(engine.url, jwt_secret=None)
+    assert not anon.upcheck()
+
+
+def test_new_payload_and_forkchoice_roundtrip(engine):
+    ctx = bellatrix_ctx()
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    process_slots(state, 1, ctx)
+    client = EngineApiClient(engine.url, jwt_secret=SECRET)
+    result = client.new_payload(make_payload(ctx, state))
+    assert result["status"] == PayloadStatus.VALID
+    assert "0x2222" in next(iter(engine.payloads)) or engine.payloads
+    fc = client.forkchoice_updated(b"\x22" * 32, b"\x22" * 32, b"\x00" * 32)
+    assert fc["payloadStatus"]["status"] == PayloadStatus.VALID
+    assert engine.forkchoice["headBlockHash"] == "0x" + ("22" * 32)
+
+
+def test_state_transition_consults_engine(engine):
+    """process_execution_payload accepts on VALID/SYNCING, rejects on
+    INVALID — the payload_invalidation.rs fault injection."""
+    el = ExecutionLayer([EngineApiClient(engine.url, jwt_secret=SECRET)])
+    ctx = bellatrix_ctx(execution_engine=el)
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    process_slots(state, 1, ctx)
+    # mark merge complete so the payload is checked against a parent
+    state.latest_execution_payload_header = ctx.types.ExecutionPayloadHeader(
+        block_hash=b"\x11" * 32, block_number=7
+    )
+    payload = make_payload(ctx, state)
+    process_execution_payload(state, payload, ctx)
+    assert bytes(state.latest_execution_payload_header.block_hash) == b"\x22" * 32
+    assert el.last_status == PayloadStatus.VALID
+
+    engine.next_status = "INVALID"
+    payload2 = ctx.types.ExecutionPayload(
+        parent_hash=b"\x22" * 32,
+        prev_randao=payload.prev_randao,
+        timestamp=payload.timestamp,
+        block_hash=b"\x33" * 32,
+    )
+    with pytest.raises(StateTransitionError):
+        process_execution_payload(state, payload2, ctx)
+
+    engine.next_status = "SYNCING"  # optimistic import
+    process_execution_payload(state, payload2, ctx)
+    assert el.last_status == PayloadStatus.SYNCING
+
+
+def test_engine_fallback_first_success():
+    dead = EngineApiClient("http://127.0.0.1:1", jwt_secret=SECRET, timeout=0.3)
+    live_engine = MockExecutionEngine(jwt_secret=SECRET).start()
+    try:
+        el = ExecutionLayer([dead, EngineApiClient(live_engine.url, jwt_secret=SECRET)])
+        ctx = bellatrix_ctx()
+        state = interop_genesis_state(8, 1_600_000_000, ctx)
+        process_slots(state, 1, ctx)
+        assert el.notify_new_payload(make_payload(ctx, state)) is True
+        assert el.upcheck() == [False, True]
+    finally:
+        live_engine.stop()
+
+
+def test_all_engines_down_raises():
+    el = ExecutionLayer(
+        [EngineApiClient("http://127.0.0.1:1", timeout=0.3)]
+    )
+    ctx = bellatrix_ctx()
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    process_slots(state, 1, ctx)
+    with pytest.raises(EngineApiError):
+        el.notify_new_payload(make_payload(ctx, state))
