@@ -33,11 +33,19 @@ def batch_verify_gossip_attestations(chain, attestations, apply_to_fork_choice: 
     ctx = chain.ctx
     state = chain.head_state()
     pubkey = ctx.pubkeys.resolver(state)
+    current_slot = int(chain.slot())
 
     results: list = [None] * len(attestations)
     staged = []  # (index, indexed_attestation, signature_set)
     for i, att in enumerate(attestations):
         try:
+            # gossip slot window (attestation_verification.rs: early
+            # attestations re-queue via the reprocessing queue; stale ones
+            # beyond ATTESTATION_PROPAGATION_SLOT_RANGE drop)
+            if int(att.data.slot) > current_slot:
+                raise AttestationError("future slot")
+            if int(att.data.slot) + ctx.preset.slots_per_epoch < current_slot:
+                raise AttestationError("stale attestation")
             if not chain.fork_choice.contains_block(bytes(att.data.beacon_block_root)):
                 raise AttestationError("unknown head block")
             indexed = get_indexed_attestation(state, att, ctx.types, ctx.preset, ctx.spec)

@@ -101,6 +101,8 @@ class EngineApiClient:
                 resp = json.loads(r.read())
         except OSError as e:
             raise EngineApiError(f"engine unreachable: {e}") from e
+        except ValueError as e:  # non-JSON body behind a broken proxy
+            raise EngineApiError(f"engine returned non-JSON: {e}") from e
         if "error" in resp and resp["error"]:
             raise EngineApiError(f"engine error: {resp['error']}")
         return resp.get("result")

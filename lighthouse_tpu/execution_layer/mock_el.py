@@ -14,6 +14,7 @@ import hashlib
 import hmac
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, HTTPServer
 
 
@@ -59,6 +60,8 @@ class MockExecutionEngine:
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
 
     def _check_jwt(self, auth_header: str) -> bool:
+        from .engine_api import JWT_VALID_SECONDS
+
         if not auth_header.startswith("Bearer "):
             return False
         token = auth_header[len("Bearer ") :]
@@ -69,7 +72,14 @@ class MockExecutionEngine:
             ).digest()
             pad = "=" * (-len(sig_b64) % 4)
             got = base64.urlsafe_b64decode(sig_b64 + pad)
-            return hmac.compare_digest(expected, got)
+            if not hmac.compare_digest(expected, got):
+                return False
+            claims_b64 = signing_input.split(".")[1]
+            claims = json.loads(
+                base64.urlsafe_b64decode(claims_b64 + "=" * (-len(claims_b64) % 4))
+            )
+            # iat freshness (engine_api auth: tokens are short-lived)
+            return abs(time.time() - claims.get("iat", 0)) <= JWT_VALID_SECONDS
         except (ValueError, TypeError):
             return False
 

@@ -8,9 +8,10 @@ peers (sync/manager.rs:178)."""
 
 from __future__ import annotations
 
-from ..chain.attestation_processing import batch_verify_gossip_attestations
+from ..chain.attestation_processing import AttestationError, batch_verify_gossip_attestations
 from ..chain.beacon_chain import BlockError
 from ..scheduler import BeaconProcessor, WorkType
+from ..scheduler.reprocess import ReprocessQueue
 from .topics import Topic
 
 
@@ -19,6 +20,7 @@ class NetworkService:
         self.node_id = node_id
         self.client = client
         self.network = network
+        self.reprocess = ReprocessQueue()
         network.register(node_id, self)
 
     # -- outbound --------------------------------------------------------------
@@ -66,22 +68,43 @@ class NetworkService:
         (the simulator-scale stand-in for SyncManager + BackFillSync)."""
         chain = self.client.chain
 
+        current_slot = int(chain.slot())
+
         def handle_block(items):
             for signed in items:
                 try:
-                    chain.process_block(signed)
+                    root = chain.process_block(signed)
                 except BlockError as e:
                     if "unknown parent" in str(e):
                         self._range_sync(signed)
                     # other invalid blocks drop, as gossip verification would
+                else:
+                    # release attestations parked on this root
+                    # (work_reprocessing_queue.rs BlockImported)
+                    for att in self.reprocess.on_block_imported(root):
+                        p.submit(WorkType.GOSSIP_ATTESTATION, att)
 
         def handle_atts(items):
             results = batch_verify_gossip_attestations(chain, items)
             for att, ok in zip(items, results):
                 if ok is True:
                     self.client.op_pool.insert_attestation(att)
+                elif (
+                    isinstance(ok, AttestationError)
+                    and "unknown head block" in str(ok)
+                ):
+                    self.reprocess.park_unknown_block(
+                        att, bytes(att.data.beacon_block_root), current_slot
+                    )
+                elif isinstance(ok, AttestationError) and "future slot" in str(ok):
+                    # early arrival: park until its slot starts (bounded)
+                    self.reprocess.park_early(att, int(att.data.slot), current_slot)
 
-        self.client.processor.drain(
+        p = self.client.processor
+        # clock tick first: resubmit anything whose slot has arrived
+        for att in self.reprocess.on_slot(current_slot):
+            p.submit(WorkType.GOSSIP_ATTESTATION, att)
+        p.drain(
             {
                 WorkType.GOSSIP_BLOCK: handle_block,
                 WorkType.RPC_BLOCK: handle_block,

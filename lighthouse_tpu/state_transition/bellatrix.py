@@ -66,7 +66,14 @@ def process_execution_payload(state, payload, ctx: TransitionContext) -> None:
         raise StateTransitionError("payload timestamp mismatch")
 
     engine = getattr(ctx, "execution_engine", None) or OptimisticEngine()
-    if not engine.notify_new_payload(payload):
+    try:
+        accepted = engine.notify_new_payload(payload)
+    except Exception as e:  # noqa: BLE001 — engine transport errors
+        # an unreachable EL fails THIS import (callers drop/retry the block)
+        # without crashing the node and without marking the block invalid
+        # (the reference's ExecutionLayerErrors behave the same way)
+        raise StateTransitionError(f"execution engine unavailable: {e}") from e
+    if not accepted:
         raise StateTransitionError("execution engine rejected payload")
 
     txs_field = dict(t.ExecutionPayload.fields)["transactions"]

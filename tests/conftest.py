@@ -14,6 +14,15 @@ import pathlib
 # setdefault and leave the tests without their 8-device virtual mesh
 # (round-3 verdict, weak #4).
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The ambient axon plugin (registered by sitecustomize whenever
+# PALLAS_AXON_POOL_IPS is set) silently DISABLES the persistent compilation
+# cache even for CPU-platform runs — verified empirically in round 4: the
+# same compile writes cache entries with the var popped and none with it
+# present. Tests never touch the real chip, so drop the plugin entirely;
+# this is what makes warm reruns of the kernel suites take minutes instead
+# of the ~70-minute cold compile.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
 _flags = [
     f
     for f in os.environ.get("XLA_FLAGS", "").split()

@@ -127,11 +127,18 @@ def test_udp_bootstrap_discovers_peers():
     nodes = [DiscoveryService(generate_key()) for _ in range(4)]
     try:
         for n in nodes:
-            assert n.ping(boot.enr)
+            # UDP under a starved CPU (parallel jax compiles in CI) can
+            # miss a 5 s window; retry before declaring the ping dead
+            assert any(n.ping(boot.enr, timeout=10.0) for _ in range(3))
         # the boot node learned every caller from their pings
         assert len(boot.table) == 4
         for n in nodes:
-            n.bootstrap(boot.enr)
+            for _ in range(3):  # walk again if a NODES response timed out
+                n.bootstrap(boot.enr)
+                ids = {e.node_id() for b in n.table.buckets for e in b}
+                ids.discard(boot.enr.node_id())
+                if ids:
+                    break
         # every node discovered at least one peer besides the boot node
         for n in nodes:
             ids = {e.node_id() for b in n.table.buckets for e in b}

@@ -1,0 +1,125 @@
+"""Work reprocessing queue: early attestations wait for their slot,
+unknown-block attestations wait for the block (or expire).
+
+Mirrors /root/reference/beacon_node/network/src/beacon_processor/
+work_reprocessing_queue.rs semantics through the NetworkService pipeline."""
+
+from lighthouse_tpu.client import Client, ClientConfig
+from lighthouse_tpu.network import LocalNetwork, NetworkService
+from lighthouse_tpu.scheduler.reprocess import ReprocessQueue
+from lighthouse_tpu.state_transition.helpers import get_beacon_committee
+from lighthouse_tpu.types.containers import Checkpoint
+from lighthouse_tpu.validator_client import BeaconNodeApi, ValidatorClient, ValidatorStore
+
+
+def test_unit_early_and_unknown_and_expiry():
+    q = ReprocessQueue(expiry_slots=2)
+    assert q.park_early("a", ready_slot=5, current_slot=4)
+    # beyond clock-disparity tolerance: dropped, not parked (hostile peers
+    # must not grow the queue)
+    assert not q.park_early("z", ready_slot=10**9, current_slot=4)
+    assert q.on_slot(4) == []
+    assert q.on_slot(5) == ["a"]
+    q.park_unknown_block("b", b"\x01" * 32, current_slot=3)
+    q.park_unknown_block("c", b"\x02" * 32, current_slot=3)
+    assert q.on_block_imported(b"\x01" * 32) == ["b"]
+    assert q.on_block_imported(b"\x01" * 32) == []  # released once
+    # "c" expires after expiry_slots
+    assert q.on_slot(4) == []
+    assert len(q) == 1
+    q.on_slot(6)
+    assert len(q) == 0
+    assert q.expired == 1
+
+
+def _node_pair():
+    net = LocalNetwork()
+    nodes = []
+    for n in range(2):
+        client = Client(
+            ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=8)
+        )
+        service = NetworkService(f"node{n}", client, net)
+        nodes.append((client, service))
+    return net, nodes
+
+
+def test_unknown_block_attestation_waits_for_block():
+    """An attestation referencing a block node1 has not seen is parked; once
+    the block arrives over gossip and imports, the attestation verifies and
+    lands in the op pool."""
+    net, nodes = _node_pair()
+    producer, pserv = nodes[0]
+    follower, fserv = nodes[1]
+    api = BeaconNodeApi(producer.chain, op_pool=producer.op_pool)
+    store = ValidatorStore(producer.ctx)
+    for i in range(8):
+        sk, _ = producer.ctx.bls.interop_keypair(i)
+        store.add_validator(sk)
+    vc = ValidatorClient(api, store)
+    producer.chain.slot_clock.set_slot(1)
+    assert vc.on_slot(1)["proposed"] is not None
+    head = producer.chain.head_root
+    blk = producer.chain.store.get_block(head)
+
+    # attestation to the new head reaches the follower BEFORE the block
+    ctx = follower.ctx
+    committee = get_beacon_committee(producer.chain.head_state(), 1, 0, ctx.preset, ctx.spec)
+    att = ctx.types.Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=ctx.types.AttestationData(
+            slot=1,
+            index=0,
+            beacon_block_root=head,
+            source=producer.chain.head_state().current_justified_checkpoint,
+            target=Checkpoint(epoch=0, root=head),
+        ),
+        signature=b"\x00" * 96,
+    )
+    from lighthouse_tpu.network.topics import Topic
+
+    follower.chain.slot_clock.set_slot(1)
+    fserv.on_gossip(Topic.BEACON_ATTESTATION, att)
+    fserv.process_pending()
+    assert len(fserv.reprocess) == 1  # parked on the unknown root
+    assert not follower.op_pool.attestations
+
+    # now the block arrives and imports; the parked attestation is released
+    from lighthouse_tpu.network.topics import Topic
+
+    fserv.on_gossip(Topic.BEACON_BLOCK, blk)
+    fserv.process_pending()  # imports block, releases attestation
+    fserv.process_pending()  # drains the resubmitted attestation
+    assert len(fserv.reprocess) == 0
+    assert follower.op_pool.attestations, "released attestation should be pooled"
+
+
+def test_early_attestation_parked_until_slot():
+    net, nodes = _node_pair()
+    client, service = nodes[0]
+    ctx = client.ctx
+    from lighthouse_tpu.network.topics import Topic
+
+    head = client.chain.head_root
+    committee = get_beacon_committee(client.chain.head_state(), 3, 0, ctx.preset, ctx.spec)
+    att = ctx.types.Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=ctx.types.AttestationData(
+            slot=3,  # the future
+            index=0,
+            beacon_block_root=head,
+            source=client.chain.head_state().current_justified_checkpoint,
+            target=Checkpoint(epoch=0, root=head),
+        ),
+        signature=b"\x00" * 96,
+    )
+    client.chain.slot_clock.set_slot(1)
+    service.on_gossip(Topic.BEACON_ATTESTATION, att)
+    service.process_pending()
+    assert len(service.reprocess) == 1
+    # the slot arrives: released, verified, pooled
+    client.chain.slot_clock.set_slot(3)
+    service.process_pending()
+    service.process_pending()
+    assert len(service.reprocess) == 0
+    assert client.op_pool.attestations
