@@ -119,15 +119,19 @@ def bench_config3(b):
 
 def bench_config4(b):
     """#4: gossip slot at 300k validators: ~9k unaggregated sigs, dispatched
-    as BeaconProcessor-style 128-set device batches."""
+    as BeaconProcessor-style 128-set device batches, PIPELINED: every batch
+    is submitted before any verdict is awaited, so host staging of batch
+    i+1 overlaps device execution of batch i (the worker-overlap the
+    reference gets from its blocking thread pool)."""
     n = 9216
     sets = _tiled_sets(b, N_SETS)  # one batch worth; dispatch n/128 times
+    submit = getattr(b, "verify_signature_sets_async", None)
 
     def run():
-        ok = True
-        for _ in range(n // N_SETS):
-            ok &= b.verify_signature_sets(sets)
-        return ok
+        if submit is None:  # non-jax backends: sequential
+            return all(b.verify_signature_sets(sets) for _ in range(n // N_SETS))
+        futures = [submit(sets) for _ in range(n // N_SETS)]
+        return all(f.result() for f in futures)
 
     sec = _timed(run, reps=3)
     return {
@@ -146,6 +150,49 @@ def bench_config5(b):
         "metric": "sync_aggregate_512key_p50_latency",
         "value": round(sec * 1e3, 2),
         "unit": "ms",
+    }
+
+
+def bench_epoch_processing():
+    """Host-side half of config #5: the epoch-boundary transition at a
+    large validator count (SURVEY.md §7 hard part 4 — the reference runs
+    this rayon-parallel; here it is numpy-vectorized)."""
+    import random
+
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
+    from lighthouse_tpu.state_transition.altair import (
+        process_inactivity_updates,
+        process_rewards_and_penalties_altair,
+    )
+    from lighthouse_tpu.types import MINIMAL_SPEC
+    from lighthouse_tpu.types.containers import minimal_types
+    import dataclasses
+
+    n = 65536
+    ctx = TransitionContext(
+        minimal_types(),
+        dataclasses.replace(MINIMAL_SPEC, altair_fork_epoch=0),
+        bls.backend("fake"),
+    )
+    state = interop_genesis_state(n, 1600000000, ctx)
+    rng = random.Random(0)
+    state.slot = 8 * ctx.preset.slots_per_epoch
+    state.finalized_checkpoint.epoch = 6
+    state.previous_epoch_participation = [rng.randrange(0, 8) for _ in range(n)]
+    state.inactivity_scores = [rng.randrange(0, 64) for _ in range(n)]
+
+    def run():
+        process_rewards_and_penalties_altair(state, ctx)
+        process_inactivity_updates(state, ctx)
+        return True
+
+    sec = _timed(run, reps=3)
+    return {
+        "metric": "epoch_rewards_inactivity_65536_validators_p50_latency",
+        "value": round(sec * 1e3, 2),
+        "unit": "ms",
+        "validators_per_sec": round(n / sec, 1),
     }
 
 
@@ -179,6 +226,7 @@ def main() -> None:
         results["config3"] = bench_config3(b)
         results["config4"] = bench_config4(b)
         results["config5"] = bench_config5(b)
+        results["epoch_processing"] = bench_epoch_processing()
         results["cpu_oracle"] = bench_cpu_oracle()
     headline = bench_config2(b)
     results["config2"] = headline

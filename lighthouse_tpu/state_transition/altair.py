@@ -326,24 +326,30 @@ def process_justification_and_finality_altair(state, ctx: TransitionContext) -> 
 
 
 def process_inactivity_updates(state, ctx: TransitionContext) -> None:
-    from .per_epoch import get_eligible_validator_indices, is_in_inactivity_leak
+    """Vectorized (same numpy registry pass as rewards; the scalar spec form
+    is what the expressions transcribe: participating scores decay by 1,
+    others grow by the bias, and outside a leak everything recovers)."""
+    import numpy as np
+
+    from .per_epoch import is_in_inactivity_leak
 
     if get_current_epoch(state, ctx.preset) == GENESIS_EPOCH:
         return
     spec = ctx.spec
-    participating = get_unslashed_participating_indices(
-        state, TIMELY_TARGET_FLAG_INDEX, get_previous_epoch(state, ctx.preset), ctx
+    eff, slashed, active_prev, eligible, participation = _epoch_arrays(state, ctx)
+    participating = (
+        active_prev & ~slashed & ((participation >> TIMELY_TARGET_FLAG_INDEX) & 1).astype(bool)
     )
-    leak = is_in_inactivity_leak(state, ctx)
-    for index in get_eligible_validator_indices(state, ctx):
-        score = state.inactivity_scores[index]
-        if index in participating:
-            score -= min(1, score)
-        else:
-            score += spec.inactivity_score_bias
-        if not leak:
-            score -= min(spec.inactivity_score_recovery_rate, score)
-        state.inactivity_scores[index] = score
+    scores = np.fromiter(
+        state.inactivity_scores, dtype=np.int64, count=len(state.inactivity_scores)
+    )
+    new = np.where(
+        participating, scores - np.minimum(1, scores), scores + spec.inactivity_score_bias
+    )
+    if not is_in_inactivity_leak(state, ctx):
+        new = new - np.minimum(spec.inactivity_score_recovery_rate, new)
+    scores = np.where(eligible, new, scores)
+    state.inactivity_scores = [int(s) for s in scores]
 
 
 def get_flag_index_deltas(
@@ -404,18 +410,83 @@ def _proportional_slashing_multiplier(state, ctx: TransitionContext) -> int:
     return ctx.spec.proportional_slashing_multiplier_altair
 
 
+def _epoch_arrays(state, ctx: TransitionContext):
+    """The per-validator vectors every altair epoch computation reads —
+    gathered ONCE per epoch into numpy int64 (the role rayon-parallel
+    per-validator iteration plays for the reference at 300k validators,
+    SURVEY.md §7 hard part 4). int64 is safe: the largest intermediate,
+    base_reward * weight * unslashed_increments, is < 2^60 even at
+    10^7 validators."""
+    import numpy as np
+
+    prev = get_previous_epoch(state, ctx.preset)
+    n = len(state.validators)
+    eff = np.empty(n, dtype=np.int64)
+    slashed = np.empty(n, dtype=bool)
+    active_prev = np.empty(n, dtype=bool)
+    withdrawable = np.empty(n, dtype=np.float64)  # only compared, never summed
+    for i, v in enumerate(state.validators):
+        eff[i] = v.effective_balance
+        slashed[i] = v.slashed
+        active_prev[i] = v.activation_epoch <= prev < v.exit_epoch
+        withdrawable[i] = v.withdrawable_epoch
+    eligible = active_prev | (slashed & (prev + 1 < withdrawable))
+    participation = np.fromiter(
+        state.previous_epoch_participation, dtype=np.int64, count=n
+    )
+    return eff, slashed, active_prev, eligible, participation
+
+
 def process_rewards_and_penalties_altair(state, ctx: TransitionContext) -> None:
+    """Vectorized altair rewards: identical arithmetic to the spec loop
+    (get_flag_index_deltas / get_inactivity_penalty_deltas, kept above as
+    the differential reference and the rewards-API surface), computed as
+    whole-registry numpy expressions."""
+    import numpy as np
+
+    from .per_epoch import is_in_inactivity_leak
+
     if get_current_epoch(state, ctx.preset) == GENESIS_EPOCH:
         return
-    deltas = [
-        get_flag_index_deltas(state, flag_index, ctx)
-        for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
-    ]
-    deltas.append(get_inactivity_penalty_deltas(state, ctx))
-    for rewards, penalties in deltas:
-        for index in range(len(state.validators)):
-            increase_balance(state, index, rewards[index])
-            decrease_balance(state, index, penalties[index])
+    spec = ctx.spec
+    incr = spec.effective_balance_increment
+    eff, slashed, active_prev, eligible, participation = _epoch_arrays(state, ctx)
+    per_increment = get_base_reward_per_increment(state, ctx)
+    base_reward = (eff // incr) * per_increment
+    active_increments = get_total_active_balance(state, ctx.preset, spec) // incr
+    leak = is_in_inactivity_leak(state, ctx)
+
+    balances = np.fromiter(state.balances, dtype=np.int64, count=len(state.balances))
+    for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+        participating = active_prev & ~slashed & ((participation >> flag_index) & 1).astype(bool)
+        # get_total_balance floors at one increment (helpers.get_total_balance)
+        unslashed_increments = max(incr, int(eff[participating].sum())) // incr
+        rewards = np.zeros_like(balances)
+        penalties = np.zeros_like(balances)
+        if not leak:
+            numer = base_reward * weight * unslashed_increments
+            rewards = np.where(
+                eligible & participating,
+                numer // (active_increments * WEIGHT_DENOMINATOR),
+                0,
+            )
+        if flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties = np.where(
+                eligible & ~participating, base_reward * weight // WEIGHT_DENOMINATOR, 0
+            )
+        balances = np.maximum(0, balances + rewards - penalties)
+
+    # inactivity penalties (quadratic leak component)
+    target_participating = (
+        active_prev & ~slashed & ((participation >> TIMELY_TARGET_FLAG_INDEX) & 1).astype(bool)
+    )
+    scores = np.fromiter(state.inactivity_scores, dtype=np.int64, count=len(balances))
+    quotient = spec.inactivity_score_bias * _inactivity_penalty_quotient(state, ctx)
+    inactivity_penalties = np.where(
+        eligible & ~target_participating, eff * scores // quotient, 0
+    )
+    balances = np.maximum(0, balances - inactivity_penalties)
+    state.balances = [int(b) for b in balances]
 
 
 def process_slashings_altair(state, ctx: TransitionContext) -> None:
