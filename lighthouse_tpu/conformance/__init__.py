@@ -10,5 +10,18 @@ the same behaviors the official bls runner checks.
 """
 
 from .bls_cases import ALL_CASE_TYPES, BlsCase, generate_bls_cases, run_case
+from .transition_cases import (
+    TransitionCase,
+    generate_transition_cases,
+    run_transition_case,
+)
 
-__all__ = ["ALL_CASE_TYPES", "BlsCase", "generate_bls_cases", "run_case"]
+__all__ = [
+    "ALL_CASE_TYPES",
+    "BlsCase",
+    "TransitionCase",
+    "generate_bls_cases",
+    "generate_transition_cases",
+    "run_case",
+    "run_transition_case",
+]
