@@ -41,7 +41,7 @@ os.environ.setdefault(
     "JAX_COMPILATION_CACHE_DIR",
     str(pathlib.Path(__file__).resolve().parent / ".jax_cache"),
 )
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "1")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 N_SETS = 128
@@ -216,6 +216,14 @@ def bench_cpu_oracle():
 
 def main() -> None:
     from lighthouse_tpu.crypto import bls
+
+    import jax
+
+    # the ambient plugin pins the persistent-cache threshold at startup;
+    # config.update outranks it (see tests/conftest.py) — moot for axon
+    # remote compiles, but the CPU fallback platform benefits
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 
     b = bls.backend("jax")
     run_all = "--all" in sys.argv
