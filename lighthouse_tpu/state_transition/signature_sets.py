@@ -267,6 +267,31 @@ def get_block_root_at_slot_for_sync(state, slot: int, preset: Preset) -> bytes:
     return get_block_root_at_slot(state, slot, preset)
 
 
+def sync_contribution_signature_set(
+    state, contribution, participant_pubkeys: list[bytes], bls, preset: Preset, spec: ChainSpec
+):
+    """The aggregate inside a SignedContributionAndProof: participants of
+    one subcommittee over the contribution's block root
+    (sync_committee_verification.rs's inner-signature check)."""
+    from ..ssz.types import Bytes32
+
+    domain = schedule_domain(
+        spec,
+        spec.domain_sync_committee,
+        compute_epoch(int(contribution.slot), preset),
+        state.genesis_validators_root,
+    )
+    sd = SigningData(
+        object_root=Bytes32.hash_tree_root(bytes(contribution.beacon_block_root)),
+        domain=domain,
+    )
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, contribution.signature),
+        signing_keys=[_decompress_cached(bls, bytes(pk)) for pk in participant_pubkeys],
+        message=SigningData.hash_tree_root(sd),
+    )
+
+
 def sync_committee_message_signature_set(state, message, bls, pubkey, preset: Preset, spec: ChainSpec):
     """A single validator's sync-committee message (sync duty signing; the
     VC-side counterpart of the aggregate above)."""

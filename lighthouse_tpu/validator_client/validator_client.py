@@ -148,6 +148,37 @@ class ValidatorStore:
         root = compute_signing_root(message, domain)
         return self._key_for(pubkey, "AGGREGATE_AND_PROOF").sign(root).to_bytes()
 
+    def sign_sync_selection_proof(
+        self, pubkey: bytes, slot: int, subcommittee_index: int, state
+    ) -> bytes:
+        """SyncAggregatorSelectionData signature deciding sync-subcommittee
+        aggregator duty (signing_method.rs SyncSelectionProof)."""
+        ctx = self.ctx
+        domain = schedule_domain(
+            ctx.spec,
+            ctx.spec.domain_sync_committee_selection_proof,
+            slot // ctx.preset.slots_per_epoch,
+            state.genesis_validators_root,
+        )
+        obj = ctx.types.SyncAggregatorSelectionData(
+            slot=slot, subcommittee_index=subcommittee_index
+        )
+        root = compute_signing_root(obj, domain)
+        return self._key_for(pubkey, "SYNC_COMMITTEE_SELECTION_PROOF").sign(root).to_bytes()
+
+    def sign_contribution_and_proof(self, pubkey: bytes, message, state) -> bytes:
+        ctx = self.ctx
+        domain = schedule_domain(
+            ctx.spec,
+            ctx.spec.domain_contribution_and_proof,
+            int(message.contribution.slot) // ctx.preset.slots_per_epoch,
+            state.genesis_validators_root,
+        )
+        root = compute_signing_root(message, domain)
+        return self._key_for(pubkey, "SYNC_COMMITTEE_CONTRIBUTION_AND_PROOF").sign(
+            root
+        ).to_bytes()
+
     def sign_sync_committee_message(
         self, pubkey: bytes, slot: int, block_root: bytes, state
     ) -> bytes:
@@ -344,6 +375,107 @@ class BeaconNodeApi:
         self.op_pool.insert_attestation(att)
         return True
 
+    # sync contributions (validator/sync_committee_contribution + POST)
+    def produce_sync_contribution(self, slot: int, block_root: bytes, subcommittee_index: int):
+        """Best contribution for a subcommittee from the pooled messages
+        (the naive aggregation pool read the reference serves aggregators)."""
+        ctx = self.chain.ctx
+        size = ctx.preset.sync_committee_size
+        from ..types import SYNC_COMMITTEE_SUBNET_COUNT
+
+        sub_size = size // SYNC_COMMITTEE_SUBNET_COUNT
+        per_pos = self.sync_pool.positions_with_own_signature(slot, block_root)
+        lo = subcommittee_index * sub_size
+        sub_bits = [lo + i in per_pos for i in range(sub_size)]
+        if not any(sub_bits):
+            return None
+        sub_sigs = [per_pos[lo + i] for i in range(sub_size) if sub_bits[i]]
+        return ctx.types.SyncCommitteeContribution(
+            slot=slot,
+            beacon_block_root=bytes(block_root),
+            subcommittee_index=subcommittee_index,
+            aggregation_bits=sub_bits,
+            signature=ctx.bls.aggregate_signatures(sub_sigs).to_bytes(),
+        )
+
+    def publish_contribution(self, signed_contribution) -> bool:
+        """Admit a SignedContributionAndProof: selection proof + outer
+        signature + the contribution's aggregate, one batched call
+        (sync_committee_verification.rs)."""
+        from ..state_transition import signature_sets as sigsets
+        from ..state_transition.helpers import StateTransitionError
+
+        ctx = self.chain.ctx
+        state = self.chain.head_state()
+        if ctx.types.fork_of(state) == "phase0":
+            return False
+        msg = signed_contribution.message
+        contribution = msg.contribution
+        from ..types import SYNC_COMMITTEE_SUBNET_COUNT
+
+        size = ctx.preset.sync_committee_size
+        sub_size = size // SYNC_COMMITTEE_SUBNET_COUNT
+        sub_index = int(contribution.subcommittee_index)
+        if sub_index >= SYNC_COMMITTEE_SUBNET_COUNT:
+            return False
+        committee = self._sync_committee_for_message_slot(int(contribution.slot))
+        if committee is None:
+            return False
+        lo = sub_index * sub_size
+        participant_pks = [
+            committee[lo + i]
+            for i, bit in enumerate(contribution.aggregation_bits)
+            if bit
+        ]
+        if not participant_pks:
+            return False
+        # the aggregator must be a MEMBER of this subcommittee and its proof
+        # must actually SELECT it (sync_committee_verification.rs
+        # AggregatorNotInCommittee / InvalidSelectionProof)
+        if not (0 <= int(msg.aggregator_index) < len(state.validators)):
+            return False
+        agg_pk = bytes(state.validators[int(msg.aggregator_index)].pubkey)
+        if agg_pk not in committee[lo : lo + sub_size]:
+            return False
+        if not is_sync_aggregator(sub_size, bytes(msg.selection_proof)):
+            return False
+        resolver = ctx.pubkeys.resolver(state)
+        try:
+            sets = [
+                sigsets.sync_selection_proof_signature_set(
+                    state,
+                    int(contribution.slot),
+                    sub_index,
+                    int(msg.aggregator_index),
+                    msg.selection_proof,
+                    ctx.bls,
+                    resolver,
+                    ctx.preset,
+                    ctx.spec,
+                    types=ctx.types,
+                ),
+                sigsets.contribution_and_proof_signature_set(
+                    state, signed_contribution, ctx.bls, resolver, ctx.preset, ctx.spec
+                ),
+                sigsets.sync_contribution_signature_set(
+                    state, contribution, participant_pks, ctx.bls, ctx.preset, ctx.spec
+                ),
+            ]
+        except StateTransitionError:
+            return False
+        if not ctx.bls.verify_signature_sets(sets):
+            return False
+        # fold into the pool at full-committee positions
+        positions = [lo + i for i, bit in enumerate(contribution.aggregation_bits) if bit]
+        self.sync_pool.add_aggregate(
+            int(contribution.slot),
+            bytes(contribution.beacon_block_root),
+            sub_index,
+            positions,
+            bytes(contribution.signature),
+        )
+        return True
+
     # sync committee duties (validator/duties/sync + sync_committee pool)
     def _sync_committee_for_message_slot(self, slot: int) -> list[bytes] | None:
         """Pubkeys (by position) of the committee that will VERIFY messages
@@ -447,6 +579,16 @@ class BeaconNodeApi:
 
 
 TARGET_AGGREGATORS_PER_COMMITTEE = 16
+TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
+
+
+def is_sync_aggregator(subcommittee_size: int, selection_proof: bytes) -> bool:
+    """Spec is_sync_committee_aggregator (altair validator guide)."""
+    import hashlib
+
+    modulo = max(1, subcommittee_size // TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE)
+    digest = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0
 
 
 def is_aggregator(committee_length: int, selection_proof: bytes) -> bool:
@@ -519,7 +661,13 @@ class ValidatorClient:
         ctx = self.ctx
         epoch = compute_epoch_at_slot(slot, ctx.preset)
         self._register_doppelganger(epoch)
-        summary = {"proposed": None, "attested": 0, "synced": 0, "aggregated": 0}
+        summary = {
+            "proposed": None,
+            "attested": 0,
+            "synced": 0,
+            "aggregated": 0,
+            "contributions": 0,
+        }
 
         # -- block duty (block_service.rs) --
         if epoch not in self._proposer_cache:
@@ -607,7 +755,8 @@ class ValidatorClient:
 
         # -- sync committee duties (sync_committee_service.rs) --
         head_root = self.api.chain.head_root
-        for pk, positions in self.api.sync_duties(self.store.pubkeys(), slot).items():
+        sync_duties = self.api.sync_duties(self.store.pubkeys(), slot)
+        for pk, positions in sync_duties.items():
             vi = index_by_pk.get(pk)
             if vi is None or not self._may_sign(vi, epoch):
                 continue
@@ -620,4 +769,31 @@ class ValidatorClient:
             )
             if self.api.publish_sync_message(msg):
                 summary["synced"] += 1
+
+        # -- sync contribution duty (per-subcommittee aggregators) --
+        from ..types import SYNC_COMMITTEE_SUBNET_COUNT
+
+        sub_size = ctx.preset.sync_committee_size // SYNC_COMMITTEE_SUBNET_COUNT
+        for pk, positions in sync_duties.items():
+            vi = index_by_pk.get(pk)
+            if vi is None or not self._may_sign(vi, epoch):
+                continue
+            for sub_index in sorted({p // sub_size for p in positions}):
+                proof = self.store.sign_sync_selection_proof(pk, slot, sub_index, head_state)
+                if not is_sync_aggregator(sub_size, proof):
+                    continue
+                contribution = self.api.produce_sync_contribution(slot, head_root, sub_index)
+                if contribution is None:
+                    continue
+                message = ctx.types.ContributionAndProof(
+                    aggregator_index=vi,
+                    contribution=contribution,
+                    selection_proof=proof,
+                )
+                signed = ctx.types.SignedContributionAndProof(
+                    message=message,
+                    signature=self.store.sign_contribution_and_proof(pk, message, head_state),
+                )
+                if self.api.publish_contribution(signed):
+                    summary["contributions"] += 1
         return summary

@@ -113,6 +113,7 @@ def test_doppelganger_detection_via_chain_observation():
 
     state = chain.head_state()
     committee = get_beacon_committee(state, SLOTS, 0, ctx.preset, ctx.spec)
+    chain.slot_clock.set_slot(SLOTS)  # the gossip slot window admits <= now
     data = ctx.types.AttestationData(
         slot=SLOTS,
         index=0,
@@ -129,6 +130,72 @@ def test_doppelganger_detection_via_chain_observation():
     assert d.detected(), "foreign attestation in the window must be detected"
     detected_index = next(iter(d.detected()))
     assert not d.allows_signing(detected_index, 100)
+
+
+def test_sync_contribution_flow_ref():
+    """Aggregators produce per-subcommittee SignedContributionAndProofs that
+    verify (three-set batch) and fold into a SECOND node's pool — the gossip
+    object other nodes actually consume (sync_committee_verification.rs)."""
+    ctx, chain, vc = altair_vc("ref")
+    chain.slot_clock.set_slot(1)
+    s = vc.on_slot(1)
+    assert s["synced"] > 0
+    # minimal preset: subcommittee size 32/4 = 8 -> everyone aggregates
+    assert s["contributions"] > 0
+
+    # replay one contribution into a fresh node's api: it must verify and
+    # populate that node's pool
+    from lighthouse_tpu.chain.beacon_chain import BeaconChain
+    from lighthouse_tpu.state_transition import interop_genesis_state
+
+    genesis = interop_genesis_state(8, 1_600_000_000, ctx)
+    other_chain = BeaconChain(genesis, ctx)
+    # other node knows the same chain (same genesis; import the head block)
+    other_chain.slot_clock.set_slot(1)
+    other_chain.process_block(chain.store.get_block(chain.head_root))
+    other_api = BeaconNodeApi(other_chain)
+
+    head_root = chain.head_root
+    contribution = vc.api.produce_sync_contribution(1, head_root, 0)
+    assert contribution is not None
+    state = chain.head_state()
+    index_by_pk = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
+    # find an aggregator whose proof selects (minimal: modulo 1 -> all)
+    duties = vc.api.sync_duties(vc.store.pubkeys(), 1)
+    pk, positions = next((p, pos) for p, pos in duties.items() if any(q // 8 == 0 for q in pos))
+    proof = vc.store.sign_sync_selection_proof(pk, 1, 0, state)
+    message = ctx.types.ContributionAndProof(
+        aggregator_index=index_by_pk[pk], contribution=contribution, selection_proof=proof
+    )
+    signed = ctx.types.SignedContributionAndProof(
+        message=message,
+        signature=vc.store.sign_contribution_and_proof(pk, message, state),
+    )
+    assert other_api.publish_contribution(signed) is True
+    agg = other_api.sync_pool.get_sync_aggregate(1, head_root)
+    assert any(agg.sync_committee_bits)
+
+    # forged outer signature is refused
+    forged = ctx.types.SignedContributionAndProof(message=message, signature=b"\x21" * 96)
+    assert other_api.publish_contribution(forged) is False
+    # tampered participation bits no longer match the aggregate
+    bad_contrib = ctx.types.SyncCommitteeContribution.deserialize(
+        ctx.types.SyncCommitteeContribution.serialize(contribution)
+    )
+    bits = list(bad_contrib.aggregation_bits)
+    flip = bits.index(True)
+    bits[flip] = False
+    if not any(bits):
+        bits[(flip + 1) % len(bits)] = True
+    bad_contrib.aggregation_bits = bits
+    bad_msg = ctx.types.ContributionAndProof(
+        aggregator_index=index_by_pk[pk], contribution=bad_contrib, selection_proof=proof
+    )
+    bad_signed = ctx.types.SignedContributionAndProof(
+        message=bad_msg,
+        signature=vc.store.sign_contribution_and_proof(pk, bad_msg, state),
+    )
+    assert other_api.publish_contribution(bad_signed) is False
 
 
 # -- aggregation duty ----------------------------------------------------------
