@@ -44,6 +44,10 @@ class NetworkService:
                 else WorkType.GOSSIP_AGGREGATE,
                 message,
             )
+        elif topic == Topic.SYNC_COMMITTEE:
+            self.client.api.publish_sync_message(message)
+        elif topic == Topic.SYNC_COMMITTEE_CONTRIBUTION:
+            self.client.api.publish_contribution(message)
         elif topic == Topic.VOLUNTARY_EXIT:
             self.client.op_pool.insert_voluntary_exit(message)
         elif topic == Topic.PROPOSER_SLASHING:

@@ -56,8 +56,23 @@ class SocketNetwork:
         digest = compute_fork_digest(
             bytes(state.fork.current_version), bytes(state.genesis_validators_root)
         )
+        subnet = None
+        if topic == Topic.BEACON_ATTESTATION:
+            from ..state_transition.helpers import get_committee_count_per_slot
+            from .topics import compute_subnet_for_attestation
+
+            ctx = chain.ctx
+            data = message.data
+            subnet = compute_subnet_for_attestation(
+                get_committee_count_per_slot(
+                    state, int(data.target.epoch), ctx.preset
+                ),
+                int(data.slot),
+                int(data.index),
+                ctx.preset.slots_per_epoch,
+            )
         ssz = self._encode(topic, message)
-        entry["gossip"].publish(topic.full_name(digest), ssz)
+        entry["gossip"].publish(topic.full_name(digest, subnet), ssz)
 
     def blocks_by_range(self, requester_id: str, start_slot: int, count: int):
         if count <= 0:
@@ -105,6 +120,8 @@ class SocketNetwork:
         decoder = {
             Topic.BEACON_ATTESTATION: t.Attestation,
             Topic.BEACON_AGGREGATE_AND_PROOF: t.SignedAggregateAndProof,
+            Topic.SYNC_COMMITTEE: t.SyncCommitteeMessage,
+            Topic.SYNC_COMMITTEE_CONTRIBUTION: t.SignedContributionAndProof,
             Topic.VOLUNTARY_EXIT: t.SignedVoluntaryExit,
             Topic.PROPOSER_SLASHING: t.ProposerSlashing,
             Topic.ATTESTER_SLASHING: t.AttesterSlashing,
@@ -124,15 +141,18 @@ class SocketNetwork:
         return cached
 
     def _deliver(self, service, topic_name: str, payload: bytes) -> None:
-        # /eth2/{digest}/{name}/ssz_snappy
+        # /eth2/{digest}/{name}[_{subnet}]/ssz_snappy
         parts = topic_name.strip("/").split("/")
         if len(parts) != 4 or parts[0] != "eth2" or parts[3] != "ssz_snappy":
             return
         try:
             digest = bytes.fromhex(parts[1])
-            topic = Topic(parts[2])
         except ValueError:
             return
+        parsed = Topic.parse_wire_name(parts[2])
+        if parsed is None:
+            return
+        topic, _subnet = parsed
         if digest not in self._valid_digests(service.client.chain):
             return  # unknown fork digest: not subscribed (types/topics.rs)
         try:

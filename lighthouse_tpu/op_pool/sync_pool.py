@@ -88,7 +88,7 @@ class SyncMessagePool:
         if entry is None or (not entry.per_pos and not entry.best_agg):
             return empty_sync_aggregate(t)
         size = self.ctx.preset.sync_committee_size
-        sub_size = size // SYNC_COMMITTEE_SUBNET_COUNT
+        sub_size = self.ctx.preset.sync_subcommittee_size
         bits = [False] * size
         sigs: list = []
         for sub in range(SYNC_COMMITTEE_SUBNET_COUNT):

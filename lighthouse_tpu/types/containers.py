@@ -359,7 +359,7 @@ class SpecTypes:
                 ("slot", uint64),
                 ("beacon_block_root", Bytes32),
                 ("subcommittee_index", uint64),
-                ("aggregation_bits", Bitvector(p.sync_committee_size // 4)),
+                ("aggregation_bits", Bitvector(p.sync_subcommittee_size)),
                 ("signature", Bytes96),
             ]
 

@@ -92,6 +92,13 @@ class Preset:
     def slots_per_eth1_voting_period(self) -> int:
         return self.epochs_per_eth1_voting_period * self.slots_per_epoch
 
+    @property
+    def sync_subcommittee_size(self) -> int:
+        """Positions per sync subnet (sync_committee_size /
+        SYNC_COMMITTEE_SUBNET_COUNT) — the single source for the five call
+        sites and the SyncCommitteeContribution bitvector length."""
+        return self.sync_committee_size // SYNC_COMMITTEE_SUBNET_COUNT
+
 
 # /root/reference/consensus/types/src/eth_spec.rs:238 (MainnetEthSpec)
 MAINNET_PRESET = Preset(

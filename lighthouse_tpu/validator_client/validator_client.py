@@ -380,10 +380,7 @@ class BeaconNodeApi:
         """Best contribution for a subcommittee from the pooled messages
         (the naive aggregation pool read the reference serves aggregators)."""
         ctx = self.chain.ctx
-        size = ctx.preset.sync_committee_size
-        from ..types import SYNC_COMMITTEE_SUBNET_COUNT
-
-        sub_size = size // SYNC_COMMITTEE_SUBNET_COUNT
+        sub_size = ctx.preset.sync_subcommittee_size
         per_pos = self.sync_pool.positions_with_own_signature(slot, block_root)
         lo = subcommittee_index * sub_size
         sub_bits = [lo + i in per_pos for i in range(sub_size)]
@@ -413,8 +410,7 @@ class BeaconNodeApi:
         contribution = msg.contribution
         from ..types import SYNC_COMMITTEE_SUBNET_COUNT
 
-        size = ctx.preset.sync_committee_size
-        sub_size = size // SYNC_COMMITTEE_SUBNET_COUNT
+        sub_size = ctx.preset.sync_subcommittee_size
         sub_index = int(contribution.subcommittee_index)
         if sub_index >= SYNC_COMMITTEE_SUBNET_COUNT:
             return False
@@ -771,9 +767,8 @@ class ValidatorClient:
                 summary["synced"] += 1
 
         # -- sync contribution duty (per-subcommittee aggregators) --
-        from ..types import SYNC_COMMITTEE_SUBNET_COUNT
-
-        sub_size = ctx.preset.sync_committee_size // SYNC_COMMITTEE_SUBNET_COUNT
+        sub_size = ctx.preset.sync_subcommittee_size
+        contribution_cache: dict[int, object] = {}  # one pool scan per sub
         for pk, positions in sync_duties.items():
             vi = index_by_pk.get(pk)
             if vi is None or not self._may_sign(vi, epoch):
@@ -782,7 +777,11 @@ class ValidatorClient:
                 proof = self.store.sign_sync_selection_proof(pk, slot, sub_index, head_state)
                 if not is_sync_aggregator(sub_size, proof):
                     continue
-                contribution = self.api.produce_sync_contribution(slot, head_root, sub_index)
+                if sub_index not in contribution_cache:
+                    contribution_cache[sub_index] = self.api.produce_sync_contribution(
+                        slot, head_root, sub_index
+                    )
+                contribution = contribution_cache[sub_index]
                 if contribution is None:
                     continue
                 message = ctx.types.ContributionAndProof(

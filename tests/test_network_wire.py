@@ -170,6 +170,71 @@ def test_gossip_floods_with_dedup_line_topology():
         c.close()
 
 
+def test_attestation_subnet_mapping():
+    from lighthouse_tpu.network.topics import (
+        ATTESTATION_SUBNET_COUNT,
+        compute_subnet_for_attestation,
+    )
+
+    # spec formula: committees since epoch start + index, mod 64
+    assert compute_subnet_for_attestation(4, 9, 2, 8) == 6
+    assert compute_subnet_for_attestation(64, 31, 63, 32) == (64 * 31 + 63) % 64
+    assert 0 <= compute_subnet_for_attestation(13, 12345, 7, 32) < ATTESTATION_SUBNET_COUNT
+    n = Topic.BEACON_ATTESTATION.full_name(b"\x0a\x0b\x0c\x0d", 9)
+    assert n == "/eth2/0a0b0c0d/beacon_attestation_9/ssz_snappy"
+    assert Topic.parse_wire_name("beacon_attestation_9") == (Topic.BEACON_ATTESTATION, 9)
+    assert Topic.parse_wire_name("beacon_attestation_x") is None
+
+
+def test_attestation_gossip_rides_subnet_topic_over_sockets():
+    """An attestation published over the socket network travels on its
+    subnet-qualified topic and still lands in the peer's pipeline."""
+    clients = [
+        Client(ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=8))
+        for _ in range(2)
+    ]
+    net = SocketNetwork(clients[0].ctx)
+    services = [NetworkService(f"node{n}", c, net) for n, c in enumerate(clients)]
+    try:
+        seen = []
+        orig = net._deliver
+
+        def spy(service, topic_name, payload):
+            seen.append(topic_name)
+            return orig(service, topic_name, payload)
+
+        net._deliver = spy
+        from lighthouse_tpu.state_transition.helpers import get_beacon_committee
+        from lighthouse_tpu.types.containers import Checkpoint
+
+        ctx = clients[0].ctx
+        chain = clients[0].chain
+        chain.slot_clock.set_slot(1)
+        clients[1].chain.slot_clock.set_slot(1)
+        state = chain.head_state()
+        committee = get_beacon_committee(state, 1, 0, ctx.preset, ctx.spec)
+        att = ctx.types.Attestation(
+            aggregation_bits=[True] * len(committee),
+            data=ctx.types.AttestationData(
+                slot=1,
+                index=0,
+                beacon_block_root=chain.head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=0, root=chain.head_root),
+            ),
+            signature=b"\x00" * 96,
+        )
+        services[0].publish_attestation(att)
+        deadline = time.time() + 5
+        while not seen and time.time() < deadline:
+            time.sleep(0.03)
+        assert seen and "beacon_attestation_" in seen[0]
+        services[1].process_pending()
+        assert clients[1].op_pool.attestations
+    finally:
+        net.close()
+
+
 def test_gossip_message_id_is_spec_shaped():
     assert len(message_id(b"hello")) == 20
     assert message_id(b"a") != message_id(b"b")
