@@ -1,0 +1,81 @@
+"""Populate .jax_cache from a CLEAN-environment child process.
+
+pytest runs only READ the persistent cache: forcing in-process writes
+segfaults inside jax's executable serializer when the ambient accelerator
+plugin is loaded (see tests/conftest.py and NOTES_r4.md). Child processes
+whose environment is cleaned BEFORE the interpreter starts write the same
+executables without crashing — this script spawns one to compile the
+representative kernel shapes (single-chip verify buckets + the 8-device
+sharded program), so subsequent test runs and driver dryruns start warm.
+
+Run: python scripts/warm_cache.py   (takes tens of minutes cold; reruns
+are no-ops because every compile hits the cache)
+"""
+
+import os
+import pathlib
+import subprocess
+import sys
+
+_ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+_CHILD = r"""
+import os, sys
+sys.path.insert(0, "@ROOT@")
+import jax
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+from lighthouse_tpu.crypto import bls
+
+b = bls.backend("jax")
+pairs = [b.interop_keypair(i) for i in range(4)]
+msg = b"\x5c" * 32
+
+def sets(n, k):
+    sk, pk = pairs[0]
+    agg = b.aggregate_signatures([s.sign(msg) for s, _ in pairs[:k]])
+    keys = [p for _, p in pairs[:k]]
+    one = b.SignatureSet(signature=agg, signing_keys=keys, message=msg)
+    return [one] * n
+
+for n, k in ((4, 1), (4, 4), (128, 1)):
+    ok = b.verify_signature_sets(sets(n, k))
+    print(f"warmed verify S={n} K={k}: {ok}", flush=True)
+    assert ok
+
+from lighthouse_tpu.parallel.sharded import build_sharded_verify, make_mesh
+from lighthouse_tpu.crypto.bls.jax_backend import api as japi
+import jax.numpy as jnp
+
+mesh = make_mesh(8)
+staged = japi.stage_sets(sets(8, 1), rng=japi._ONE_RNG, s_floor=8)
+kernel = build_sharded_verify(mesh)
+assert bool(kernel(*(jnp.asarray(a) for a in staged)))
+print("warmed 8-device sharded verify", flush=True)
+"""
+
+
+def main() -> None:
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [
+        f
+        for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", str(_ROOT / ".jax_cache"))
+    env["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+    env["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"] = "0"
+    proc = subprocess.run(
+        [sys.executable, "-c", _CHILD.replace("@ROOT@", str(_ROOT))], env=env, cwd=str(_ROOT)
+    )
+    raise SystemExit(proc.returncode)
+
+
+if __name__ == "__main__":
+    main()
