@@ -214,7 +214,13 @@ def bench_cpu_oracle():
     }
 
 
-def main() -> None:
+def child_main() -> None:
+    """Run the actual measurement in-process and print the JSON line.
+
+    Invoked by the orchestrator in a subprocess so a wedged accelerator
+    tunnel (the axon backend can hang indefinitely mid-RPC) cannot take the
+    whole bench down — the parent enforces a wall-clock deadline.
+    """
     from lighthouse_tpu.crypto import bls
 
     import jax
@@ -237,6 +243,7 @@ def main() -> None:
         results["epoch_processing"] = bench_epoch_processing()
         results["cpu_oracle"] = bench_cpu_oracle()
     headline = bench_config2(b)
+    headline["platform"] = jax.devices()[0].platform
     results["config2"] = headline
 
     if run_all:
@@ -247,6 +254,80 @@ def main() -> None:
                 print(f"# {k}: {json.dumps(v)}", file=sys.stderr)
 
     print(json.dumps(headline))
+
+
+def _run_child(extra_env, timeout_sec, args=()):
+    """Run child_main in a subprocess; return the parsed last-JSON-line or None."""
+    import subprocess
+
+    env = dict(os.environ, **extra_env)
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", *args]
+    try:
+        proc = subprocess.run(
+            cmd, env=env, timeout=timeout_sec,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout_sec}s"
+    sys.stderr.write(proc.stderr[-4000:])
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()
+        return None, (tail[-1][:300] if tail else f"rc={proc.returncode}")
+    for line in reversed(proc.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+            if isinstance(parsed, dict) and "metric" in parsed:
+                return parsed, None
+        except (json.JSONDecodeError, ValueError):
+            continue
+    return None, "child produced no JSON line"
+
+
+def main() -> None:
+    """Wedge-proof orchestrator: NEVER exits nonzero, ALWAYS prints one JSON
+    line, regardless of accelerator-tunnel health (two prior rounds lost
+    their perf record to rc=1 benches — see VERDICT round 4, Weak #1)."""
+    if "--child" in sys.argv:
+        child_main()
+        return
+
+    run_all = ["--all"] if "--all" in sys.argv else []
+    errors = []
+
+    # Attempt 1 + one retry on the default (accelerator) platform. The child
+    # import of jax is what wedges when the tunnel is down, so the deadline
+    # covers everything. --all needs a longer budget (five configs + oracle).
+    budget = int(os.environ.get("BENCH_ACCEL_TIMEOUT", 2400 if run_all else 900))
+    for attempt in range(2):
+        result, err = _run_child({}, budget, run_all)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"accel attempt {attempt + 1}: {err}")
+        sys.stderr.write(f"# bench: {errors[-1]}\n")
+
+    # Fallback: force the CPU platform (kernels persistent-cached under
+    # .jax_cache, so this is minutes not hours) and record the result with
+    # an explicit error field so the driver still gets a measurement.
+    result, err = _run_child(
+        {"JAX_PLATFORMS": "cpu"}, int(os.environ.get("BENCH_CPU_TIMEOUT", 2400)), run_all
+    )
+    if result is not None:
+        result["error"] = "; ".join(errors) + " — CPU-platform fallback measurement"
+        print(json.dumps(result))
+        return
+    errors.append(f"cpu fallback: {err}")
+
+    # Last resort: a valid JSON line carrying the diagnostics and the best
+    # previously-published measurement for context.
+    print(json.dumps({
+        "metric": "verify_signature_sets_128x1_throughput",
+        "value": 0.0,
+        "unit": "sets_per_sec",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors),
+        "last_known_tpu_sets_per_sec": 213.27,
+    }))
 
 
 if __name__ == "__main__":

@@ -17,7 +17,11 @@ from .op_pool import OperationPool
 from .scheduler import BeaconProcessor, WorkType
 from .chain.attestation_processing import batch_verify_gossip_attestations
 from .slasher import Slasher
-from .state_transition import TransitionContext, interop_genesis_state
+from .state_transition import (
+    ExecutionEngineError,
+    TransitionContext,
+    interop_genesis_state,
+)
 from .store import HotColdDB, MemoryStore
 from .validator_client import BeaconNodeApi
 
@@ -153,7 +157,10 @@ class Client:
 
         def handle_block(items):
             for signed in items:
-                self.chain.process_block(signed)
+                try:
+                    self.chain.process_block(signed)
+                except ExecutionEngineError:
+                    continue  # EL transport outage: drop, block is not invalid
 
         return self.processor.drain(
             {

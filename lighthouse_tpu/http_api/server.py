@@ -187,10 +187,14 @@ class _Handler(BaseHTTPRequestHandler):
                 if ctx.types.fork_of(state) == "phase0":
                     raise ApiError(400, "state is pre-altair")
                 index_of = {bytes(v.pubkey): i for i, v in enumerate(state.validators)}
-                validators = [
-                    str(index_of.get(bytes(pk), 0))
-                    for pk in state.current_sync_committee.pubkeys
-                ]
+                validators = []
+                for pk in state.current_sync_committee.pubkeys:
+                    idx = index_of.get(bytes(pk))
+                    if idx is None:
+                        raise ApiError(
+                            500, "sync committee pubkey not in validator registry"
+                        )
+                    validators.append(str(idx))
                 self._send(200, _data({"validators": validators}))
             else:
                 raise ApiError(404, "unknown state endpoint")

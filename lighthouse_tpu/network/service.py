@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..chain.attestation_processing import AttestationError, batch_verify_gossip_attestations
 from ..chain.beacon_chain import BlockError
+from ..state_transition import ExecutionEngineError
 from ..scheduler import BeaconProcessor, WorkType
 from ..scheduler.reprocess import ReprocessQueue
 from .topics import Topic
@@ -78,6 +79,10 @@ class NetworkService:
             for signed in items:
                 try:
                     root = chain.process_block(signed)
+                except ExecutionEngineError:
+                    # EL transport outage: the block is NOT invalid — drop it
+                    # and let re-gossip/range-sync retry once the EL is back
+                    continue
                 except BlockError as e:
                     if "unknown parent" in str(e):
                         self._range_sync(signed)
@@ -131,9 +136,11 @@ class NetworkService:
         for signed in blocks:
             try:
                 chain.process_block(signed)
+            except ExecutionEngineError:
+                return  # EL outage: abort the sync, retry on next trigger
             except BlockError:
                 pass
         try:
             chain.process_block(orphan_block)
-        except BlockError:
+        except (BlockError, ExecutionEngineError):
             pass

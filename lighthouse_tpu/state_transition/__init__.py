@@ -11,7 +11,7 @@ per_block.process_operations); scheduled upgrades run inside process_slots.
 from .altair import upgrade_to_altair
 from .bellatrix import upgrade_to_bellatrix
 from .context import PubkeyCache, TransitionContext
-from .helpers import StateTransitionError
+from .helpers import ExecutionEngineError, StateTransitionError
 from .per_block import (
     BlockSignatureStrategy,
     BlockSignatureVerifier,

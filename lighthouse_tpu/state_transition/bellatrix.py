@@ -17,7 +17,12 @@ from __future__ import annotations
 
 from ..types.containers import Fork
 from .context import TransitionContext
-from .helpers import StateTransitionError, get_current_epoch, get_randao_mix
+from .helpers import (
+    ExecutionEngineError,
+    StateTransitionError,
+    get_current_epoch,
+    get_randao_mix,
+)
 
 
 class OptimisticEngine:
@@ -69,10 +74,10 @@ def process_execution_payload(state, payload, ctx: TransitionContext) -> None:
     try:
         accepted = engine.notify_new_payload(payload)
     except Exception as e:  # noqa: BLE001 — engine transport errors
-        # an unreachable EL fails THIS import (callers drop/retry the block)
-        # without crashing the node and without marking the block invalid
-        # (the reference's ExecutionLayerErrors behave the same way)
-        raise StateTransitionError(f"execution engine unavailable: {e}") from e
+        # an unreachable EL is a transport failure, not consensus
+        # invalidity: raise the distinct type so import paths can
+        # retry/queue instead of treating the block as invalid
+        raise ExecutionEngineError(f"execution engine unavailable: {e}") from e
     if not accepted:
         raise StateTransitionError("execution engine rejected payload")
 

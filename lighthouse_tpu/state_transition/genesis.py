@@ -83,6 +83,8 @@ def _upgrade_genesis_to_scheduled_fork(state, ctx: TransitionContext):
         from .bellatrix import upgrade_to_bellatrix
 
         upgrade_to_bellatrix(state, ctx)
+        # merged-at-genesis: same no-previous-fork rule as altair above
+        state.fork.previous_version = ctx.spec.bellatrix_fork_version
     return state
 
 

@@ -33,6 +33,15 @@ class StateTransitionError(Exception):
     """Invalid block / invalid state transition."""
 
 
+class ExecutionEngineError(Exception):
+    """Execution-engine transport failure — NOT consensus invalidity.
+
+    Mirrors the reference's ExecutionLayerError vs InvalidBlock split
+    (beacon_chain/src/errors.rs): importers catch this to retry or queue
+    optimistically instead of marking the block invalid.
+    """
+
+
 def _hash(data: bytes) -> bytes:
     return hashlib.sha256(data).digest()
 

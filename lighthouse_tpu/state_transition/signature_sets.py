@@ -16,11 +16,16 @@ The signed *message* in every set is a 32-byte signing root
 (compute_signing_root = hash_tree_root(SigningData{object_root, domain})),
 so sets from heterogeneous operations batch uniformly on the device.
 
-Domains come from the ChainSpec fork SCHEDULE (types.schedule_domain), not
-the state's fork record: for on-schedule states the two agree, and the
-schedule stays correct when verification runs against a head state that has
-not yet crossed a fork boundary the signed epoch is in (gossip at a fork's
-first slots).
+Domain derivation follows the reference split (chain_spec.rs get_domain ->
+Fork::get_fork_version): every constructor consumed by per_block_processing
+/ process_operations derives its domain from the STATE's fork record
+(types.get_domain — previous_version for epochs before the fork epoch,
+current_version from it onward), because block validity must agree with
+other clients on operations signed up to one fork back. Gossip-time-only
+constructors (selection proofs, aggregate-and-proof wrappers, sync-committee
+messages/contributions) use the ChainSpec fork SCHEDULE
+(types.schedule_domain) so verification against a head state that has not
+yet crossed a fork boundary still derives the signer's domain.
 """
 
 from __future__ import annotations
@@ -30,6 +35,7 @@ from ..types import (
     ChainSpec,
     Preset,
     compute_signing_root,
+    get_domain,
     schedule_domain,
 )
 from ..types.containers import SigningData
@@ -62,11 +68,8 @@ def block_proposal_signature_set(
     block = signed_block.message
     if block.proposer_index != proposer_index:
         raise StateTransitionError("incorrect proposer index")
-    domain = schedule_domain(
-        spec,
-        spec.domain_beacon_proposer,
-        compute_epoch(block.slot, preset),
-        state.genesis_validators_root,
+    domain = get_domain(
+        state, spec.domain_beacon_proposer, compute_epoch(block.slot, preset), preset
     )
     root = compute_signing_root(block, domain)
     return bls.SignatureSet(
@@ -84,9 +87,7 @@ def randao_signature_set(state, randao_reveal, proposer_index: int, bls, pubkey,
     """signature_sets.rs randao_signature_set: message is the epoch (as SSZ
     uint64) under DOMAIN_RANDAO."""
     epoch = compute_epoch(state.slot, preset)
-    domain = schedule_domain(
-        spec, spec.domain_randao, epoch, state.genesis_validators_root
-    )
+    domain = get_domain(state, spec.domain_randao, epoch, preset)
     root = _signing_root_for_uint64(epoch, domain)
     return bls.SignatureSet(
         signature=_decode_signature(bls, randao_reveal),
@@ -99,11 +100,8 @@ def block_header_signature_set(state, signed_header, bls, pubkey, preset: Preset
     """One half of a proposer slashing (signature_sets.rs
     proposer_slashing_signature_set builds two of these)."""
     header = signed_header.message
-    domain = schedule_domain(
-        spec,
-        spec.domain_beacon_proposer,
-        compute_epoch(header.slot, preset),
-        state.genesis_validators_root,
+    domain = get_domain(
+        state, spec.domain_beacon_proposer, compute_epoch(header.slot, preset), preset
     )
     root = compute_signing_root(header, domain)
     return bls.SignatureSet(
@@ -120,15 +118,26 @@ def proposer_slashing_signature_sets(state, slashing, bls, pubkey, preset: Prese
     )
 
 
+def _attester_domain(state, spec: ChainSpec, epoch: int, preset: Preset) -> bytes:
+    """Domain a state *advanced to `epoch`* would derive via get_domain.
+
+    The reference verifies gossip attestations against a shuffling-cache state
+    at the attestation's target epoch, whose fork record is on schedule for
+    that epoch; block-path states are advanced to the block slot before
+    verification. Both reduce to: state.fork for epochs the state has crossed,
+    the schedule for epochs past the state's fork record (a head state at a
+    fork's first slots before any post-fork block lands)."""
+    if spec.fork_epoch(spec.fork_name_at_epoch(epoch)) > int(state.fork.epoch):
+        return schedule_domain(
+            spec, spec.domain_beacon_attester, epoch, state.genesis_validators_root
+        )
+    return get_domain(state, spec.domain_beacon_attester, epoch, preset)
+
+
 def indexed_attestation_signature_set(state, indexed, bls, pubkey, preset: Preset, spec: ChainSpec):
     """signature_sets.rs indexed_attestation_signature_set: one set with ALL
     attesting pubkeys (aggregate verify of the same message)."""
-    domain = schedule_domain(
-        spec,
-        spec.domain_beacon_attester,
-        indexed.data.target.epoch,
-        state.genesis_validators_root,
-    )
+    domain = _attester_domain(state, spec, int(indexed.data.target.epoch), preset)
     root = compute_signing_root(indexed.data, domain)
     keys = [_resolve(pubkey, i) for i in indexed.attesting_indices]
     return bls.SignatureSet(
@@ -172,9 +181,7 @@ def deposit_signature_set(deposit_data, bls, spec: ChainSpec):
 
 def exit_signature_set(state, signed_exit, bls, pubkey, preset: Preset, spec: ChainSpec):
     exit_msg = signed_exit.message
-    domain = schedule_domain(
-        spec, spec.domain_voluntary_exit, exit_msg.epoch, state.genesis_validators_root
-    )
+    domain = get_domain(state, spec.domain_voluntary_exit, int(exit_msg.epoch), preset)
     root = compute_signing_root(exit_msg, domain)
     return bls.SignatureSet(
         signature=_decode_signature(bls, signed_exit.signature),
@@ -240,11 +247,8 @@ def sync_aggregate_signature_set(state, sync_aggregate, bls, preset: Preset, spe
         raise StateTransitionError("sync aggregate: no participants but non-infinity sig")
 
     previous_slot = max(state.slot, 1) - 1
-    domain = schedule_domain(
-        spec,
-        spec.domain_sync_committee,
-        previous_slot // preset.slots_per_epoch,
-        state.genesis_validators_root,
+    domain = get_domain(
+        state, spec.domain_sync_committee, previous_slot // preset.slots_per_epoch, preset
     )
     block_root = get_block_root_at_slot_for_sync(state, previous_slot, preset)
     sd = SigningData(object_root=Bytes32.hash_tree_root(block_root), domain=domain)
