@@ -39,18 +39,19 @@ def batch_verify_gossip_attestations(chain, attestations, apply_to_fork_choice: 
     staged = []  # (index, indexed_attestation, signature_set)
     for i, att in enumerate(attestations):
         try:
-            # gossip slot window (attestation_verification.rs: early
-            # attestations re-queue via the reprocessing queue; stale ones
-            # beyond ATTESTATION_PROPAGATION_SLOT_RANGE drop)
-            if int(att.data.slot) > current_slot:
-                raise AttestationError("future slot")
-            if int(att.data.slot) + ctx.preset.slots_per_epoch < current_slot:
-                raise AttestationError("stale attestation")
-            if not chain.fork_choice.contains_block(bytes(att.data.beacon_block_root)):
-                raise AttestationError("unknown head block")
+            _common_attestation_checks(chain, att, current_slot)
             indexed = get_indexed_attestation(state, att, ctx.types, ctx.preset, ctx.spec)
             if not indexed.attesting_indices:
                 raise AttestationError("empty attestation")
+            # observed_attesters.rs PriorAttestationKnown: every attester
+            # already published for this target epoch -> drop without
+            # re-verifying (spec: one attestation per validator per epoch)
+            epoch = int(indexed.data.target.epoch)
+            if all(
+                _safe_observed(chain.observed_attesters, epoch, int(vi))
+                for vi in indexed.attesting_indices
+            ):
+                raise AttestationError("prior attestation known")
             s = sigsets.indexed_attestation_signature_set(
                 state, indexed, ctx.bls, pubkey, ctx.preset, ctx.spec
             )
@@ -74,6 +75,149 @@ def batch_verify_gossip_attestations(chain, attestations, apply_to_fork_choice: 
 
     for i, indexed, _ in staged:
         if results[i] is True:
+            epoch = int(indexed.data.target.epoch)
+            for vi in indexed.attesting_indices:
+                _safe_observe(chain.observed_attesters, epoch, int(vi))
+            for obs in chain.attestation_observers:
+                for vi in indexed.attesting_indices:
+                    obs(int(vi), int(indexed.data.target.epoch))
+            if apply_to_fork_choice:
+                try:
+                    chain.fork_choice.on_attestation(indexed)
+                except ForkChoiceError:
+                    pass
+    return results
+
+
+def _safe_observed(cache, epoch: int, index: int) -> bool:
+    from .observed import EpochTooLow
+
+    try:
+        return cache.is_observed(epoch, index)
+    except EpochTooLow:
+        return True  # below the pruning floor: too old, treat as seen
+
+
+def _safe_observe(cache, epoch: int, index: int) -> bool:
+    from .observed import EpochTooLow
+
+    try:
+        return cache.observe(epoch, index)
+    except EpochTooLow:
+        return True
+
+
+def _common_attestation_checks(chain, att, current_slot: int) -> None:
+    """The shared gossip admission list of attestation_verification.rs:607-960:
+    slot window, slot/target-epoch consistency, known blocks, and the
+    head-descends-from-target ancestry requirement."""
+    from ..types import compute_epoch_at_slot
+
+    preset = chain.ctx.preset
+    slot = int(att.data.slot)
+    # gossip slot window (early attestations re-queue via the reprocessing
+    # queue; stale ones beyond ATTESTATION_PROPAGATION_SLOT_RANGE drop)
+    if slot > current_slot:
+        raise AttestationError("future slot")
+    if slot + preset.slots_per_epoch < current_slot:
+        raise AttestationError("stale attestation")
+    if int(att.data.target.epoch) != compute_epoch_at_slot(slot, preset):
+        raise AttestationError("target epoch does not match slot")
+    head_root = bytes(att.data.beacon_block_root)
+    if not chain.fork_choice.contains_block(head_root):
+        raise AttestationError("unknown head block")
+    target_root = bytes(att.data.target.root)
+    if not chain.fork_choice.contains_block(target_root):
+        raise AttestationError("unknown target block")
+    if not chain.fork_choice.is_descendant(target_root, head_root):
+        raise AttestationError("head does not descend from target")
+
+
+def batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: bool = True):
+    """Admit a batch of gossiped SignedAggregateAndProofs.
+
+    The three-signature admission of
+    /root/reference/beacon_node/beacon_chain/src/attestation_verification.rs:1143-1201
+    — selection proof, outer aggregator signature, inner aggregate — built
+    for EVERY aggregate in the batch and dispatched as ONE backend call
+    (3*N sets), with the same per-aggregate poisoning fallback as the
+    unaggregated path. Returns a list aligned with `aggregates`: True or an
+    Exception."""
+    from ..state_transition.helpers import get_beacon_committee, is_aggregator
+
+    ctx = chain.ctx
+    state = chain.head_state()
+    resolver = ctx.pubkeys.resolver(state)
+    current_slot = int(chain.slot())
+
+    chain.observed_aggregates.prune(current_slot, ctx.preset.slots_per_epoch + 2)
+
+    results: list = [None] * len(aggregates)
+    staged = []  # (index, indexed_attestation, [three sets], agg_root)
+    for i, signed in enumerate(aggregates):
+        try:
+            msg = signed.message
+            att = msg.aggregate
+            _common_attestation_checks(chain, att, current_slot)
+            # observed_aggregates.rs AttestationKnown: identical aggregate
+            # already seen this slot
+            agg_root = type(att).hash_tree_root(att)
+            if chain.observed_aggregates.is_observed(int(att.data.slot), agg_root):
+                raise AttestationError("aggregate already known")
+            # observed_attesters.rs AggregatorAlreadyKnown
+            if _safe_observed(
+                chain.observed_aggregators,
+                int(att.data.target.epoch),
+                int(msg.aggregator_index),
+            ):
+                raise AttestationError("aggregator already known")
+            committee = get_beacon_committee(
+                state, int(att.data.slot), int(att.data.index), ctx.preset, ctx.spec
+            )
+            if int(msg.aggregator_index) not in committee:
+                raise AttestationError("aggregator not in committee")
+            if not is_aggregator(len(committee), bytes(msg.selection_proof)):
+                raise AttestationError("selection proof does not select aggregator")
+            indexed = get_indexed_attestation(state, att, ctx.types, ctx.preset, ctx.spec)
+            if not indexed.attesting_indices:
+                raise AttestationError("empty aggregate")
+            sets = [
+                sigsets.selection_proof_signature_set(
+                    state, int(att.data.slot), int(msg.aggregator_index),
+                    msg.selection_proof, ctx.bls, resolver, ctx.preset, ctx.spec,
+                ),
+                sigsets.aggregate_and_proof_signature_set(
+                    state, signed, ctx.bls, resolver, ctx.preset, ctx.spec
+                ),
+                sigsets.indexed_attestation_signature_set(
+                    state, indexed, ctx.bls, resolver, ctx.preset, ctx.spec
+                ),
+            ]
+            staged.append((i, signed, indexed, sets, agg_root))
+        except (AttestationError, StateTransitionError) as e:
+            results[i] = e
+
+    if staged:
+        all_sets = [s for _, _, _, sets, _ in staged for s in sets]
+        if ctx.bls.verify_signature_sets(all_sets):
+            for i, _, _, _, _ in staged:
+                results[i] = True
+        else:
+            for i, _, _, sets, _ in staged:
+                results[i] = (
+                    True
+                    if ctx.bls.verify_signature_sets(sets)
+                    else AttestationError("invalid signature")
+                )
+
+    for i, signed, indexed, _, agg_root in staged:
+        if results[i] is True:
+            chain.observed_aggregates.observe(int(indexed.data.slot), agg_root)
+            _safe_observe(
+                chain.observed_aggregators,
+                int(indexed.data.target.epoch),
+                int(signed.message.aggregator_index),
+            )
             for obs in chain.attestation_observers:
                 for vi in indexed.attesting_indices:
                     obs(int(vi), int(indexed.data.target.epoch))

@@ -55,6 +55,20 @@ class BeaconChain:
         self.attestation_observers: list = []
         self._last_finalized_epoch = 0
 
+        # gossip dedup / equivocation caches (observed_attesters.rs:40-43,
+        # observed_aggregates.rs, observed_block_producers.rs)
+        from .observed import (
+            ObservedAggregates,
+            ObservedAggregators,
+            ObservedAttesters,
+            ObservedBlockProducers,
+        )
+
+        self.observed_attesters = ObservedAttesters()
+        self.observed_aggregators = ObservedAggregators()
+        self.observed_aggregates = ObservedAggregates()
+        self.observed_block_producers = ObservedBlockProducers()
+
         t = ctx.types
         genesis_state_root = type(genesis_state).hash_tree_root(genesis_state)
         header = BeaconBlockHeader(
@@ -68,6 +82,19 @@ class BeaconChain:
         self.store.put_state(self.genesis_block_root, genesis_state)
         self.fork_choice = ForkChoice(self.genesis_block_root, genesis_state, ctx)
         self.head_root = self.genesis_block_root
+        # backfill frontier (store anchor info, hot_cold_store.rs AnchorInfo):
+        # for a true-genesis boot the parent root is zero and backfill is
+        # already complete; a checkpoint boot anchors mid-chain
+        self.oldest_block_root = self.genesis_block_root
+        self.oldest_block_slot = int(genesis_state.slot)
+        self._anchor_parent_root = bytes(genesis_state.latest_block_header.parent_root)
+
+    @property
+    def backfill_complete(self) -> bool:
+        """Backfill ends at the first signed block (slot 1): the genesis
+        'block' is a header with a zero parent, not a fetchable
+        SignedBeaconBlock (backfill_sync/mod.rs stops at genesis)."""
+        return self.oldest_block_slot <= 1 or self._anchor_parent_root == b"\x00" * 32
 
     # -- queries ---------------------------------------------------------------
 
@@ -105,6 +132,20 @@ class BeaconChain:
                 raise BlockError(str(e)) from e
 
         block_root = type(block).hash_tree_root(block)
+        self._post_import(block_root, signed_block, state)
+        self.recompute_head()
+        return block_root
+
+    def _post_import(self, block_root: bytes, signed_block, state) -> None:
+        """Everything after a signature-valid transition: store, events,
+        monitor, fork choice (the tail of beacon_chain.rs import_block).
+        Does NOT recompute the head — batch importers do that once."""
+        t = self.ctx.types
+        block = signed_block.message
+        # the block carried a valid proposer signature: record (slot,
+        # proposer) for the gossip equivocation guard
+        # (observed_block_producers.rs)
+        self.observed_block_producers.observe(int(block.slot), int(block.proposer_index))
         self.store.put_block(block_root, signed_block)
         self.store.put_state(block_root, state)
         self.events.emit(
@@ -125,8 +166,122 @@ class BeaconChain:
                 self.fork_choice.on_attestation(indexed, is_from_block=True)
             except ForkChoiceError:
                 pass  # e.g. attestation for a block this store never saw
+
+    def process_chain_segment(self, blocks) -> list[bytes]:
+        """Import a parent-linked ascending run of blocks with EVERY block's
+        signatures verified in ONE backend batch — the sustained-throughput
+        path range sync and backfill feed (block_verification.rs:458
+        signature_verify_chain_segment + process_chain_segment).
+
+        On the jax backend this is the big-batch device dispatch: a
+        2-epoch batch of minimal-preset blocks lands hundreds of signature
+        sets in a single device program. Raises BlockError on the first
+        structural problem; the caller may fall back to per-block import
+        for precise attribution."""
+        blocks = sorted(blocks, key=lambda b: int(b.message.slot))
+        blocks = [
+            b
+            for b in blocks
+            if self.store.get_block(type(b.message).hash_tree_root(b.message)) is None
+        ]
+        if not blocks:
+            return []
+
+        parent_root = bytes(blocks[0].message.parent_root)
+        parent_state = self.store.get_state(parent_root)
+        if parent_state is None:
+            raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
+
+        state = parent_state.copy()
+        all_sets = []
+        staged = []  # (root, signed_block, post_state)
+        prev_root = parent_root
+        from ..state_transition.per_block import BlockSignatureVerifier
+
+        for signed in blocks:
+            block = signed.message
+            if bytes(block.parent_root) != prev_root:
+                raise BlockError("segment is not parent-linked")
+            try:
+                process_slots(state, int(block.slot), self.ctx)
+                verifier = BlockSignatureVerifier(state, self.ctx)
+                verifier.include_all_signatures(signed)
+                all_sets.extend(verifier.sets)
+                per_block_processing(
+                    state, signed, self.ctx, strategy=BlockSignatureStrategy.NO_VERIFICATION
+                )
+            except StateTransitionError as e:
+                raise BlockError(str(e)) from e
+            root = type(block).hash_tree_root(block)
+            if bytes(block.state_root) != type(state).hash_tree_root(state):
+                raise BlockError("segment block state root mismatch")
+            staged.append((root, signed, state.copy()))
+            prev_root = root
+
+        if all_sets and not self.ctx.bls.verify_signature_sets(all_sets):
+            raise BlockError("segment signature verification failed")
+
+        for root, signed, post_state in staged:
+            self._post_import(root, signed, post_state)
         self.recompute_head()
-        return block_root
+        return [root for root, _, _ in staged]
+
+    def import_historical_block_batch(self, blocks) -> int:
+        """Backfill: append blocks BEHIND the chain's oldest known block.
+
+        The TPU rendering of /root/reference/beacon_node/beacon_chain/src/
+        historical_blocks.rs:59 import_historical_block_batch — the heaviest
+        sustained signature workload a node runs (whole epochs of proposer
+        signatures per call, here ONE backend batch per call):
+
+          1. hash-chain continuity: the batch's last block must be the
+             parent of the current oldest block, and each block the parent
+             of its successor (no state replay needed — the anchor's
+             ancestry commits to every root);
+          2. proposer signatures of ALL blocks verified in one batched
+             device dispatch, domains from the fork schedule;
+          3. blocks persist withOUT post-states (states are reconstructable
+             later; the freezer stores blocks + periodic restore points).
+
+        Returns the number of blocks imported. `chain.oldest_block_root/
+        oldest_block_slot` track the backfill frontier (store anchor info).
+        """
+        if not blocks:
+            return 0
+        blocks = sorted(blocks, key=lambda b: int(b.message.slot), reverse=True)
+        expected_root = self._anchor_parent_root
+        state = self.head_state()
+        resolver = self.ctx.pubkeys.resolver(state)
+        sets = []
+        chained = []
+        for signed in blocks:  # descending slots: walk parents backwards
+            block = signed.message
+            root = type(block).hash_tree_root(block)
+            if root != expected_root:
+                raise BlockError(
+                    f"historical batch breaks the hash chain at slot {int(block.slot)}"
+                )
+            sets.append(
+                sigsets.historical_block_proposal_signature_set(
+                    signed,
+                    self.ctx.bls,
+                    resolver,
+                    self.ctx.preset,
+                    self.ctx.spec,
+                    state.genesis_validators_root,
+                )
+            )
+            chained.append((root, signed))
+            expected_root = bytes(block.parent_root)
+        if not self.ctx.bls.verify_signature_sets(sets):
+            raise BlockError("historical batch signature verification failed")
+        for root, signed in chained:
+            self.store.put_block(root, signed)
+        tail_root, tail_signed = chained[-1]
+        self.oldest_block_root = tail_root
+        self.oldest_block_slot = int(tail_signed.message.slot)
+        self._anchor_parent_root = bytes(tail_signed.message.parent_root)
+        return len(chained)
 
     def apply_attestation(self, attestation) -> None:
         """Unaggregated/gossip attestation -> fork choice (the tail of
@@ -151,6 +306,9 @@ class BeaconChain:
                 fin = state.finalized_checkpoint
                 if fin.epoch > self._last_finalized_epoch:
                     self._last_finalized_epoch = fin.epoch
+                    self.observed_block_producers.prune(
+                        int(fin.epoch) * self.ctx.preset.slots_per_epoch
+                    )
                     self.events.emit(
                         "finalized_checkpoint",
                         epoch=int(fin.epoch),
@@ -199,6 +357,10 @@ class BeaconChain:
             body_kwargs["sync_aggregate"] = (
                 sync_aggregate if sync_aggregate is not None else empty_sync_aggregate(t)
             )
+        if "execution_payload" in dict(ft.BeaconBlockBody.fields):
+            payload = self._request_payload(state, slot)
+            if payload is not None:
+                body_kwargs["execution_payload"] = payload
         body = ft.BeaconBlockBody(**body_kwargs)
         block = ft.BeaconBlock(
             slot=slot,
@@ -213,6 +375,49 @@ class BeaconChain:
         )
         block.state_root = type(state).hash_tree_root(state)
         return block, state
+
+    def _request_payload(self, state, slot: int):
+        """Ask the execution engine to build the block's payload
+        (execution_layer/src/lib.rs:142-148: forkchoiceUpdated w/ payload
+        attributes -> getPayload). Returns None when no payload-building
+        engine is attached AND the chain is pre-merge (the empty payload is
+        then valid); raises ExecutionEngineError if the merge is complete
+        and no payload can be obtained — producing a payload-less block
+        post-merge would be consensus-invalid."""
+        from ..state_transition.bellatrix import (
+            compute_timestamp_at_slot,
+            is_merge_transition_complete,
+        )
+        from ..state_transition.helpers import (
+            ExecutionEngineError,
+            get_current_epoch,
+            get_randao_mix,
+        )
+
+        engine = getattr(self.ctx, "execution_engine", None)
+        build = getattr(engine, "build_payload", None)
+        merged = is_merge_transition_complete(state)
+        if build is None:
+            if merged:
+                raise ExecutionEngineError(
+                    "merge is complete but no payload-building engine attached"
+                )
+            return None
+        try:
+            return build(
+                self.ctx.types,
+                bytes(state.latest_execution_payload_header.block_hash),
+                compute_timestamp_at_slot(state, slot, self.ctx),
+                bytes(
+                    get_randao_mix(
+                        state, get_current_epoch(state, self.ctx.preset), self.ctx.preset
+                    )
+                ),
+            )
+        except Exception as e:  # noqa: BLE001 — engine transport boundary
+            if merged:
+                raise ExecutionEngineError(f"payload build failed: {e}") from e
+            return None
 
     def sign_block(self, block, secret_key):
         """Proposal signature (signature_sets.rs:55 semantics). The fork

@@ -1,0 +1,138 @@
+"""Observed-* gossip dedup caches.
+
+Python rendering of the DoS-protection caches in
+/root/reference/beacon_node/beacon_chain/src/observed_attesters.rs:40-43
+(ObservedAttesters / ObservedAggregators — auto-pruning epoch containers),
+observed_aggregates.rs (seen aggregate roots per slot), and
+observed_block_producers.rs ((slot, proposer) equivocation guard).
+
+Semantics preserved:
+  - epoch containers keep the previous/current/next epochs
+    (MAX_CACHED_EPOCHS = 3; next covers gossip clock disparity) and reject
+    epochs below the pruning floor;
+  - `observe_*` returns True when the item was ALREADY observed (the
+    caller drops the duplicate without re-verifying);
+  - block producers prune on finalization, and a repeat (slot, proposer)
+    observation flags equivocation regardless of the block root — the
+    dedup-by-root case is handled by the store before this cache is asked.
+
+Simplification vs the reference (documented): ObservedAggregates stores
+hash_tree_root(attestation) per slot rather than the non-strict-subset
+bitfield comparison of observed_aggregates.rs — byte-identical repeats are
+dropped; a strictly-smaller subset aggregate is re-verified instead of
+dropped (safe, just less thrifty).
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+# previous + current + next epoch (observed_attesters.rs MAX_CACHED_EPOCHS)
+MAX_CACHED_EPOCHS = 3
+# per-slot distinct-aggregate bound (observed_aggregates.rs's
+# ReachedMaxObservationsPerSlot DoS guard)
+MAX_OBSERVATIONS_PER_SLOT = 1 << 16
+
+
+class EpochTooLow(Exception):
+    pass
+
+
+class _EpochIndexContainer:
+    """AutoPruningEpochContainer: per-epoch sets of validator indices."""
+
+    def __init__(self):
+        self._by_epoch: dict[int, set[int]] = defaultdict(set)
+        self.lowest_permissible_epoch = 0
+
+    def observe(self, epoch: int, validator_index: int) -> bool:
+        """Record (epoch, index); returns True if it was already present."""
+        epoch, validator_index = int(epoch), int(validator_index)
+        if epoch < self.lowest_permissible_epoch:
+            raise EpochTooLow(f"epoch {epoch} < floor {self.lowest_permissible_epoch}")
+        seen = validator_index in self._by_epoch[epoch]
+        self._by_epoch[epoch].add(validator_index)
+        self._prune(epoch)
+        return seen
+
+    def is_observed(self, epoch: int, validator_index: int) -> bool:
+        if int(epoch) < self.lowest_permissible_epoch:
+            raise EpochTooLow(f"epoch {epoch} < floor {self.lowest_permissible_epoch}")
+        return int(validator_index) in self._by_epoch.get(int(epoch), set())
+
+    def _prune(self, current_epoch: int) -> None:
+        floor = max(0, current_epoch - (MAX_CACHED_EPOCHS - 1))
+        if floor > self.lowest_permissible_epoch:
+            self.lowest_permissible_epoch = floor
+        for e in [e for e in self._by_epoch if e < self.lowest_permissible_epoch]:
+            del self._by_epoch[e]
+
+    def __len__(self) -> int:
+        return sum(len(s) for s in self._by_epoch.values())
+
+
+class ObservedAttesters(_EpochIndexContainer):
+    """One unaggregated attestation per (validator, target epoch)
+    (observed_attesters.rs EpochBitfield role)."""
+
+
+class ObservedAggregators(_EpochIndexContainer):
+    """One aggregate per (aggregator, target epoch)
+    (observed_attesters.rs EpochHashSet role)."""
+
+
+class ObservedAggregates:
+    """Seen aggregate-attestation roots per slot (observed_aggregates.rs)."""
+
+    def __init__(self):
+        self._by_slot: dict[int, set[bytes]] = defaultdict(set)
+        self.lowest_permissible_slot = 0
+
+    def observe(self, slot: int, root: bytes) -> bool:
+        slot, root = int(slot), bytes(root)
+        if slot < self.lowest_permissible_slot:
+            return True  # too old to matter: treat as seen
+        bucket = self._by_slot[slot]
+        if root in bucket:
+            return True
+        if len(bucket) >= MAX_OBSERVATIONS_PER_SLOT:
+            return True  # DoS guard: refuse to grow; drop the aggregate
+        bucket.add(root)
+        return False
+
+    def is_observed(self, slot: int, root: bytes) -> bool:
+        if int(slot) < self.lowest_permissible_slot:
+            return True
+        return bytes(root) in self._by_slot.get(int(slot), ())
+
+    def prune(self, current_slot: int, keep_slots: int) -> None:
+        floor = max(0, int(current_slot) - int(keep_slots))
+        self.lowest_permissible_slot = max(self.lowest_permissible_slot, floor)
+        for s in [s for s in self._by_slot if s < self.lowest_permissible_slot]:
+            del self._by_slot[s]
+
+
+class ObservedBlockProducers:
+    """(slot, proposer_index) pairs of signature-valid blocks
+    (observed_block_producers.rs). A repeat pair is an equivocation (or a
+    re-gossip; the store dedups identical roots before this is consulted)."""
+
+    def __init__(self):
+        self._by_slot: dict[int, set[int]] = defaultdict(set)
+        self.finalized_slot = 0
+
+    def observe(self, slot: int, proposer_index: int) -> bool:
+        slot, proposer_index = int(slot), int(proposer_index)
+        if slot <= self.finalized_slot:
+            return True  # pre-finalization blocks are not re-importable
+        seen = proposer_index in self._by_slot[slot]
+        self._by_slot[slot].add(proposer_index)
+        return seen
+
+    def is_observed(self, slot: int, proposer_index: int) -> bool:
+        return int(proposer_index) in self._by_slot.get(int(slot), set())
+
+    def prune(self, finalized_slot: int) -> None:
+        self.finalized_slot = max(self.finalized_slot, int(finalized_slot))
+        for s in [s for s in self._by_slot if s <= self.finalized_slot]:
+            del self._by_slot[s]

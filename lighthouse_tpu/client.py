@@ -162,14 +162,23 @@ class Client:
                 except ExecutionEngineError:
                     continue  # EL transport outage: drop, block is not invalid
 
+        def handle_aggregates(items):
+            from .chain.attestation_processing import batch_verify_gossip_aggregates
+
+            results = batch_verify_gossip_aggregates(self.chain, items)
+            for signed, ok in zip(items, results):
+                if ok is True:
+                    self.op_pool.insert_attestation(signed.message.aggregate)
+
+        isolated = BeaconProcessor.isolated
         return self.processor.drain(
             {
-                WorkType.GOSSIP_ATTESTATION: handle_attestations,
-                WorkType.GOSSIP_BLOCK: handle_block,
-                WorkType.GOSSIP_AGGREGATE: handle_attestations,
-                WorkType.CHAIN_SEGMENT: handle_block,
-                WorkType.RPC_BLOCK: handle_block,
-                WorkType.DELAYED_BLOCK: handle_block,
+                WorkType.GOSSIP_ATTESTATION: isolated(handle_attestations),
+                WorkType.GOSSIP_BLOCK: isolated(handle_block),
+                WorkType.GOSSIP_AGGREGATE: isolated(handle_aggregates),
+                WorkType.CHAIN_SEGMENT: isolated(handle_block),
+                WorkType.RPC_BLOCK: isolated(handle_block),
+                WorkType.DELAYED_BLOCK: isolated(handle_block),
             }
         )
 

@@ -177,3 +177,7 @@ BLOCK_IMPORT_SECONDS = REGISTRY.histogram(
 PROCESSOR_QUEUE_DEPTH = REGISTRY.gauge(
     "lighthouse_tpu_processor_queue_depth", "BeaconProcessor total queued events"
 )
+PROCESSOR_ITEMS_DROPPED = REGISTRY.counter(
+    "lighthouse_tpu_processor_items_dropped_total",
+    "Work items dropped because their handler raised (hostile-input isolation)",
+)

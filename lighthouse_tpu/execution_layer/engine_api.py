@@ -78,6 +78,29 @@ def payload_to_json(payload) -> dict:
     }
 
 
+def json_to_payload(t, j: dict):
+    """Engine API JSON -> ExecutionPayload container (json_structures.rs,
+    inverse of payload_to_json)."""
+    unb = lambda v: bytes.fromhex(v[2:]) if isinstance(v, str) else bytes(v)
+    unq = lambda v: int(v, 16) if isinstance(v, str) else int(v)
+    return t.ExecutionPayload(
+        parent_hash=unb(j["parentHash"]),
+        fee_recipient=unb(j["feeRecipient"]),
+        state_root=unb(j["stateRoot"]),
+        receipts_root=unb(j["receiptsRoot"]),
+        logs_bloom=unb(j["logsBloom"]),
+        prev_randao=unb(j["prevRandao"]),
+        block_number=unq(j["blockNumber"]),
+        gas_limit=unq(j["gasLimit"]),
+        gas_used=unq(j["gasUsed"]),
+        timestamp=unq(j["timestamp"]),
+        extra_data=unb(j["extraData"]),
+        base_fee_per_gas=unq(j["baseFeePerGas"]),
+        block_hash=unb(j["blockHash"]),
+        transactions=[unb(tx) for tx in j.get("transactions", [])],
+    )
+
+
 class EngineApiClient:
     """One engine endpoint (http.rs HttpJsonRpc)."""
 
@@ -177,13 +200,54 @@ class ExecutionLayer:
             )
         raise EngineApiError(f"all engines failed: {err}")
 
-    def forkchoice_updated(self, head: bytes, safe: bytes, finalized: bytes) -> dict:
+    def forkchoice_updated(
+        self, head: bytes, safe: bytes, finalized: bytes, attrs: dict | None = None
+    ) -> dict:
         err: Exception | None = None
         for engine in self.engines:
             try:
-                return engine.forkchoice_updated(head, safe, finalized)
+                return engine.forkchoice_updated(head, safe, finalized, attrs)
             except EngineApiError as e:
                 err = e
+        raise EngineApiError(f"all engines failed: {err}")
+
+    def build_payload(
+        self,
+        t,
+        head_hash: bytes,
+        timestamp: int,
+        prev_randao: bytes,
+        fee_recipient: bytes = b"\x00" * 20,
+        safe_hash: bytes | None = None,
+        finalized_hash: bytes | None = None,
+    ):
+        """The production flow of /root/reference/beacon_node/execution_layer/
+        src/lib.rs:142-148 (get_payload): forkchoiceUpdated with payload
+        attributes -> payloadId -> getPayload -> ExecutionPayload container."""
+        attrs = {
+            "timestamp": hex(int(timestamp)),
+            "prevRandao": "0x" + bytes(prev_randao).hex(),
+            "suggestedFeeRecipient": "0x" + bytes(fee_recipient).hex(),
+        }
+        resp = self.forkchoice_updated(
+            head_hash,
+            safe_hash if safe_hash is not None else head_hash,
+            finalized_hash if finalized_hash is not None else head_hash,
+            attrs,
+        )
+        payload_id = (resp or {}).get("payloadId")
+        if payload_id is None:
+            raise EngineApiError("engine returned no payloadId")
+        err: Exception | None = None
+        for engine in self.engines:
+            try:
+                j = engine.get_payload(payload_id)
+            except EngineApiError as e:
+                err = e
+                continue
+            if j is None:
+                raise EngineApiError(f"unknown payloadId {payload_id}")
+            return json_to_payload(t, j)
         raise EngineApiError(f"all engines failed: {err}")
 
     def upcheck(self) -> list[bool]:

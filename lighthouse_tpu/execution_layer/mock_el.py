@@ -93,11 +93,42 @@ class MockExecutionEngine:
             return {"status": status, "latestValidHash": payload["parentHash"], "validationError": None}
         if method == "engine_forkchoiceUpdatedV1":
             self.forkchoice = params[0]
+            attrs = params[1] if len(params) > 1 else None
+            payload_id = None
+            if attrs:
+                # synthesize a payload honoring the attributes (the mock EL
+                # in test_utils/mock_execution_layer.rs does the same)
+                self._payload_counter = getattr(self, "_payload_counter", 0) + 1
+                payload_id = hex(0x0101010101010000 + self._payload_counter)
+                parent = params[0]["headBlockHash"]
+                body = {
+                    "parentHash": parent,
+                    "feeRecipient": attrs.get("suggestedFeeRecipient", "0x" + "00" * 20),
+                    "stateRoot": "0x" + "11" * 32,
+                    "receiptsRoot": "0x" + "22" * 32,
+                    "logsBloom": "0x" + "00" * 256,
+                    "prevRandao": attrs["prevRandao"],
+                    "blockNumber": hex(self._payload_counter),
+                    "gasLimit": hex(30_000_000),
+                    "gasUsed": "0x0",
+                    "timestamp": attrs["timestamp"],
+                    "extraData": "0x",
+                    "baseFeePerGas": hex(7),
+                }
+                body["blockHash"] = "0x" + hashlib.sha256(
+                    json.dumps(body, sort_keys=True).encode()
+                ).digest().hex()
+                body["transactions"] = []
+                self.built_payloads = getattr(self, "built_payloads", {})
+                self.built_payloads[payload_id] = body
             return {
                 "payloadStatus": {"status": "VALID", "latestValidHash": None, "validationError": None},
-                "payloadId": "0x0101010101010101",
+                "payloadId": payload_id or "0x0101010101010101",
             }
         if method == "engine_getPayloadV1":
+            built = getattr(self, "built_payloads", {})
+            if params and params[0] in built:
+                return built[params[0]]
             return next(iter(self.payloads.values()), None)
         if method == "engine_exchangeTransitionConfigurationV1":
             return params[0]

@@ -82,6 +82,26 @@ class ForkChoice:
         idx = self.proto.indices.get(root)
         return None if idx is None else self.proto.nodes[idx].slot
 
+    def is_descendant(self, ancestor_root: bytes, descendant_root: bytes) -> bool:
+        """True iff `descendant_root`'s chain passes through `ancestor_root`
+        (proto_array.rs is_descendant — the target-ancestry gossip check)."""
+        from .proto_array import NONE
+
+        a = self.proto.indices.get(bytes(ancestor_root))
+        d = self.proto.indices.get(bytes(descendant_root))
+        if a is None or d is None:
+            return False
+        a_slot = self.proto.nodes[a].slot
+        i = d
+        while i != NONE:
+            if i == a:
+                return True
+            node = self.proto.nodes[i]
+            if node.slot < a_slot:
+                return False
+            i = node.parent
+        return False
+
     # -- on_tick (fork_choice.rs on_tick) --------------------------------------
 
     def on_tick(self, slot: int) -> None:

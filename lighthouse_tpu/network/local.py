@@ -35,3 +35,34 @@ class LocalNetwork:
             if blocks:
                 return blocks
         return []
+
+    # -- per-peer surface for the sync machines --------------------------------
+
+    def peer_ids(self, requester_id: str) -> list[str]:
+        return [nid for nid in self.peers if nid != requester_id]
+
+    def blocks_by_range_from(
+        self, requester_id: str, peer_id: str, start_slot: int, count: int
+    ):
+        from .sync import SyncPeerError
+
+        service = self.peers.get(peer_id)
+        if service is None:
+            raise SyncPeerError(f"unknown peer {peer_id}")
+        try:
+            return service.serve_blocks_by_range(start_slot, count)
+        except Exception as e:  # noqa: BLE001 — peer failure, not ours
+            raise SyncPeerError(f"peer {peer_id}: {e}") from e
+
+    def status_of(self, node_id: str, peer_id: str):
+        from .rpc import StatusMessage
+
+        chain = self.peers[peer_id].client.chain
+        state = chain.head_state()
+        return StatusMessage(
+            fork_digest=b"\x00" * 4,
+            finalized_root=bytes(state.finalized_checkpoint.root),
+            finalized_epoch=int(state.finalized_checkpoint.epoch),
+            head_root=chain.head_root,
+            head_slot=int(state.slot),
+        )

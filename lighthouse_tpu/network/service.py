@@ -8,7 +8,11 @@ peers (sync/manager.rs:178)."""
 
 from __future__ import annotations
 
-from ..chain.attestation_processing import AttestationError, batch_verify_gossip_attestations
+from ..chain.attestation_processing import (
+    AttestationError,
+    batch_verify_gossip_aggregates,
+    batch_verify_gossip_attestations,
+)
 from ..chain.beacon_chain import BlockError
 from ..state_transition import ExecutionEngineError
 from ..scheduler import BeaconProcessor, WorkType
@@ -18,10 +22,13 @@ from .topics import Topic
 
 class NetworkService:
     def __init__(self, node_id: str, client, network):
+        from .sync import SyncManager
+
         self.node_id = node_id
         self.client = client
         self.network = network
         self.reprocess = ReprocessQueue()
+        self.sync = SyncManager(self)
         network.register(node_id, self)
 
     # -- outbound --------------------------------------------------------------
@@ -31,6 +38,11 @@ class NetworkService:
 
     def publish_attestation(self, attestation) -> None:
         self.network.publish(self.node_id, Topic.BEACON_ATTESTATION, attestation)
+
+    def publish_aggregate(self, signed_aggregate) -> None:
+        self.network.publish(
+            self.node_id, Topic.BEACON_AGGREGATE_AND_PROOF, signed_aggregate
+        )
 
     # -- inbound (router/mod.rs on_network_msg) --------------------------------
 
@@ -56,6 +68,16 @@ class NetworkService:
         elif topic == Topic.ATTESTER_SLASHING:
             self.client.op_pool.insert_attester_slashing(message)
 
+    def exchange_status(self) -> None:
+        """Status-handshake every peer; a peer ahead of us starts range sync
+        (router.rs on_status_response -> SyncManager add_peer)."""
+        for peer_id in self.network.peer_ids(self.node_id):
+            try:
+                status = self.network.status_of(self.node_id, peer_id)
+            except Exception:  # noqa: BLE001 — unreachable peer
+                continue
+            self.sync.on_status(int(status.head_slot))
+
     # -- req/resp server (rpc BlocksByRange) -----------------------------------
 
     def serve_blocks_by_range(self, start_slot: int, count: int):
@@ -75,8 +97,20 @@ class NetworkService:
 
         current_slot = int(chain.slot())
 
-        def handle_block(items):
+        def handle_block(items, gossip: bool = False):
             for signed in items:
+                block = signed.message
+                root = type(block).hash_tree_root(block)
+                if chain.store.get_block(root) is not None:
+                    continue  # duplicate of an imported block: ignore
+                if gossip and chain.observed_block_producers.is_observed(
+                    int(block.slot), int(block.proposer_index)
+                ):
+                    # a DIFFERENT signature-valid block from this proposer at
+                    # this slot was already imported: gossip equivocation,
+                    # reject without importing (observed_block_producers.rs;
+                    # the slasher sees both via proposer-slashing gossip)
+                    continue
                 try:
                     root = chain.process_block(signed)
                 except ExecutionEngineError:
@@ -90,8 +124,8 @@ class NetworkService:
                 else:
                     # release attestations parked on this root
                     # (work_reprocessing_queue.rs BlockImported)
-                    for att in self.reprocess.on_block_imported(root):
-                        p.submit(WorkType.GOSSIP_ATTESTATION, att)
+                    for wt, att in self.reprocess.on_block_imported(root):
+                        p.submit(wt, att)
 
         def handle_atts(items):
             results = batch_verify_gossip_attestations(chain, items)
@@ -109,38 +143,50 @@ class NetworkService:
                     # early arrival: park until its slot starts (bounded)
                     self.reprocess.park_early(att, int(att.data.slot), current_slot)
 
+        def handle_aggs(items):
+            # SignedAggregateAndProofs: three-set admission per aggregate,
+            # one device batch for all of them
+            results = batch_verify_gossip_aggregates(chain, items)
+            for signed, ok in zip(items, results):
+                att = signed.message.aggregate
+                if ok is True:
+                    self.client.op_pool.insert_attestation(att)
+                elif (
+                    isinstance(ok, AttestationError)
+                    and "unknown head block" in str(ok)
+                ):
+                    self.reprocess.park_unknown_block(
+                        signed, bytes(att.data.beacon_block_root), current_slot,
+                        work_type=WorkType.GOSSIP_AGGREGATE,
+                    )
+                elif isinstance(ok, AttestationError) and "future slot" in str(ok):
+                    self.reprocess.park_early(
+                        signed, int(att.data.slot), current_slot,
+                        work_type=WorkType.GOSSIP_AGGREGATE,
+                    )
+
         p = self.client.processor
+        isolated = BeaconProcessor.isolated
         # clock tick first: resubmit anything whose slot has arrived
-        for att in self.reprocess.on_slot(current_slot):
-            p.submit(WorkType.GOSSIP_ATTESTATION, att)
+        for wt, item in self.reprocess.on_slot(current_slot):
+            p.submit(wt, item)
         p.drain(
             {
-                WorkType.GOSSIP_BLOCK: handle_block,
-                WorkType.RPC_BLOCK: handle_block,
-                WorkType.DELAYED_BLOCK: handle_block,
-                WorkType.CHAIN_SEGMENT: handle_block,
-                WorkType.GOSSIP_ATTESTATION: handle_atts,
-                WorkType.GOSSIP_AGGREGATE: handle_atts,
+                WorkType.GOSSIP_BLOCK: isolated(
+                    lambda items: handle_block(items, gossip=True)
+                ),
+                WorkType.RPC_BLOCK: isolated(handle_block),
+                WorkType.DELAYED_BLOCK: isolated(handle_block),
+                WorkType.CHAIN_SEGMENT: isolated(handle_block),
+                WorkType.GOSSIP_ATTESTATION: isolated(handle_atts),
+                WorkType.GOSSIP_AGGREGATE: isolated(handle_aggs),
             }
         )
 
     def _range_sync(self, orphan_block) -> None:
-        """Fetch the missing range [head+1, orphan.slot) from peers and
-        import in order, then retry the orphan."""
-        chain = self.client.chain
-        head_slot = int(chain.head_state().slot)
-        target_slot = int(orphan_block.message.slot)
-        blocks = self.network.blocks_by_range(
-            self.node_id, head_slot + 1, max(0, target_slot - head_slot - 1)
-        )
-        for signed in blocks:
-            try:
-                chain.process_block(signed)
-            except ExecutionEngineError:
-                return  # EL outage: abort the sync, retry on next trigger
-            except BlockError:
-                pass
+        """Unknown-parent trigger: hand the gap to the SyncManager
+        (sync/manager.rs UnknownParentBlock -> RangeSync)."""
         try:
-            chain.process_block(orphan_block)
-        except (BlockError, ExecutionEngineError):
-            pass
+            self.sync.on_unknown_parent(orphan_block)
+        except ExecutionEngineError:
+            pass  # EL outage mid-sync: retry on the next trigger

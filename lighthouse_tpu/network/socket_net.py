@@ -94,6 +94,31 @@ class SocketNetwork:
                 ]
         return []
 
+    def peer_ids(self, requester_id: str) -> list[str]:
+        with self._lock:
+            return [nid for nid in self._nodes if nid != requester_id]
+
+    def blocks_by_range_from(
+        self, requester_id: str, peer_id: str, start_slot: int, count: int
+    ):
+        from .sync import SyncPeerError
+
+        if count <= 0:
+            return []
+        with self._lock:
+            entry = self._nodes.get(peer_id)
+        if entry is None:
+            raise SyncPeerError(f"unknown peer {peer_id}")
+        req = rpc.BlocksByRangeRequest(start_slot=start_slot, count=count, step=1)
+        try:
+            chunks = rpc.request(entry["rpc"].addr, rpc.Protocol.BLOCKS_BY_RANGE, req)
+        except (OSError, RuntimeError, ValueError) as e:
+            raise SyncPeerError(f"peer {peer_id}: {e}") from e
+        return [
+            decode_signed_block(c, self.ctx.types, self.ctx.spec, self.ctx.preset)
+            for c in chunks
+        ]
+
     def status_of(self, node_id: str, peer_id: str) -> rpc.StatusMessage:
         """Status handshake from node_id's view of peer_id (rpc status)."""
         me = self._nodes[node_id]

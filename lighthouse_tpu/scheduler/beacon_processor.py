@@ -128,6 +128,27 @@ class BeaconProcessor:
             return Batch(work_type=wt, items=items)
         return None
 
+    @staticmethod
+    def isolated(handler):
+        """Hostile-input boundary for drain handlers: when a batch handler
+        raises, retry per item and drop the single offender — one malformed
+        message must not wedge the drain (the worker-panic isolation the
+        reference gets from per-task workers)."""
+
+        def run(items):
+            try:
+                handler(items)
+            except Exception:  # noqa: BLE001 — hostile-input boundary
+                for item in items:
+                    try:
+                        handler([item])
+                    except Exception:  # noqa: BLE001
+                        from ..common.metrics import PROCESSOR_ITEMS_DROPPED
+
+                        PROCESSOR_ITEMS_DROPPED.inc()
+
+        return run
+
     def drain(self, handlers: dict, max_batches: int | None = None) -> int:
         """Drain by priority through `handlers[work_type](items)`; returns
         the number of batches processed. The synchronous in-process stand-in

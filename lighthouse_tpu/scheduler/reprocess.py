@@ -26,18 +26,26 @@ MAX_PARKED = 16384  # the BeaconProcessor event-queue bound, reused
 class _Parked:
     item: object
     expires_at_slot: int
+    work_type: object = None
 
 
 class ReprocessQueue:
     def __init__(self, expiry_slots: int = EXPIRY_SLOTS):
         self.expiry_slots = expiry_slots
-        self._early: list[tuple[int, object]] = []  # (ready_slot, item)
+        # (ready_slot, work_type, item)
+        self._early: list[tuple[int, object, object]] = []
         self._by_root: dict[bytes, list[_Parked]] = defaultdict(list)
         self.expired = 0
 
+    @staticmethod
+    def _default_work_type():
+        from . import WorkType
+
+        return WorkType.GOSSIP_ATTESTATION
+
     # -- parking ---------------------------------------------------------------
 
-    def park_early(self, item, ready_slot: int, current_slot: int) -> bool:
+    def park_early(self, item, ready_slot: int, current_slot: int, work_type=None) -> bool:
         """An attestation for a future slot (early-arrival clamping,
         work_reprocessing_queue.rs QueuedUnaggregate early path). Only slots
         within clock-disparity tolerance park; the rest drop — a hostile
@@ -46,25 +54,27 @@ class ReprocessQueue:
             return False
         if len(self) >= MAX_PARKED:
             return False
-        self._early.append((int(ready_slot), item))
+        wt = work_type if work_type is not None else self._default_work_type()
+        self._early.append((int(ready_slot), wt, item))
         return True
 
-    def park_unknown_block(self, item, block_root: bytes, current_slot: int) -> bool:
+    def park_unknown_block(self, item, block_root: bytes, current_slot: int, work_type=None) -> bool:
         """An attestation whose beacon_block_root the chain has not imported."""
         if len(self) >= MAX_PARKED:
             return False
+        wt = work_type if work_type is not None else self._default_work_type()
         self._by_root[bytes(block_root)].append(
-            _Parked(item, int(current_slot) + self.expiry_slots)
+            _Parked(item, int(current_slot) + self.expiry_slots, wt)
         )
         return True
 
     # -- triggers --------------------------------------------------------------
 
     def on_slot(self, current_slot: int) -> list:
-        """Release items whose slot has arrived; expire stale unknown-block
-        parkings."""
-        ready = [item for slot, item in self._early if slot <= current_slot]
-        self._early = [(s, i) for s, i in self._early if s > current_slot]
+        """Release (work_type, item) pairs whose slot has arrived; expire
+        stale unknown-block parkings."""
+        ready = [(wt, item) for slot, wt, item in self._early if slot <= current_slot]
+        self._early = [(s, wt, i) for s, wt, i in self._early if s > current_slot]
         for root in list(self._by_root):
             kept = [p for p in self._by_root[root] if p.expires_at_slot > current_slot]
             self.expired += len(self._by_root[root]) - len(kept)
@@ -75,10 +85,10 @@ class ReprocessQueue:
         return ready
 
     def on_block_imported(self, block_root: bytes) -> list:
-        """Release everything waiting on this root (the reprocessing queue's
-        BlockImported message)."""
+        """Release (work_type, item) pairs waiting on this root (the
+        reprocessing queue's BlockImported message)."""
         parked = self._by_root.pop(bytes(block_root), [])
-        return [p.item for p in parked]
+        return [(p.work_type, p.item) for p in parked]
 
     def __len__(self) -> int:
         return len(self._early) + sum(len(v) for v in self._by_root.values())

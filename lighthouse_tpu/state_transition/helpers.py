@@ -293,3 +293,14 @@ def is_slashable_attestation_data(d1, d2) -> bool:
     double = ad.hash_tree_root(d1) != ad.hash_tree_root(d2) and d1.target.epoch == d2.target.epoch
     surround = d1.source.epoch < d2.source.epoch and d2.target.epoch < d1.target.epoch
     return double or surround
+
+
+TARGET_AGGREGATORS_PER_COMMITTEE = 16
+
+
+def is_aggregator(committee_length: int, selection_proof: bytes) -> bool:
+    """Spec is_aggregator: hash of the selection proof picks ~16 aggregators
+    per committee (attestation_service.rs:125-230's slot+2/3 duty)."""
+    modulo = max(1, committee_length // TARGET_AGGREGATORS_PER_COMMITTEE)
+    digest = hashlib.sha256(selection_proof).digest()
+    return int.from_bytes(digest[:8], "little") % modulo == 0

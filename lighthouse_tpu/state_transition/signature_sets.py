@@ -83,6 +83,32 @@ def compute_epoch(slot: int, preset: Preset) -> int:
     return slot // preset.slots_per_epoch
 
 
+def historical_block_proposal_signature_set(
+    signed_block, bls, pubkey, preset: Preset, spec: ChainSpec,
+    genesis_validators_root: bytes,
+):
+    """Proposer signature of a backfilled historical block.
+
+    Backfill batches reach arbitrarily far behind the anchor state's fork
+    record, so the domain comes from the ChainSpec SCHEDULE at the block's
+    epoch — exactly what an on-schedule state at that epoch would derive
+    (historical_blocks.rs:59 import_historical_block_batch verifies against
+    the per-epoch fork)."""
+    block = signed_block.message
+    domain = schedule_domain(
+        spec,
+        spec.domain_beacon_proposer,
+        compute_epoch(int(block.slot), preset),
+        bytes(genesis_validators_root),
+    )
+    root = compute_signing_root(block, domain)
+    return bls.SignatureSet(
+        signature=_decode_signature(bls, signed_block.signature),
+        signing_keys=[_resolve(pubkey, int(block.proposer_index))],
+        message=root,
+    )
+
+
 def randao_signature_set(state, randao_reveal, proposer_index: int, bls, pubkey, preset: Preset, spec: ChainSpec):
     """signature_sets.rs randao_signature_set: message is the epoch (as SSZ
     uint64) under DOMAIN_RANDAO."""

@@ -319,60 +319,15 @@ class BeaconNodeApi:
         return best
 
     def publish_aggregate(self, signed_aggregate) -> bool:
-        """Admit a SignedAggregateAndProof: ONE batched backend call covers
-        the selection proof, the outer aggregator signature, and the inner
-        aggregate (attestation_verification.rs's three-set admission)."""
-        from ..state_transition import signature_sets as sigsets
-        from ..state_transition.helpers import (
-            StateTransitionError,
-            get_beacon_committee,
-            get_indexed_attestation,
-        )
+        """Admit a SignedAggregateAndProof via the chain-level three-set
+        batched admission (attestation_processing.batch_verify_gossip_
+        aggregates — attestation_verification.rs:1143-1201)."""
+        from ..chain.attestation_processing import batch_verify_gossip_aggregates
 
-        ctx = self.chain.ctx
-        state = self.chain.head_state()
-        msg = signed_aggregate.message
-        att = msg.aggregate
-        resolver = ctx.pubkeys.resolver(state)
-        try:
-            committee = get_beacon_committee(
-                state, int(att.data.slot), int(att.data.index), ctx.preset, ctx.spec
-            )
-            if int(msg.aggregator_index) not in committee:
-                return False
-            # the proof must actually SELECT this validator (the reference's
-            # InvalidSelectionProof admission check) — a valid signature that
-            # hashes to a non-zero modulo is still not an aggregator
-            if not is_aggregator(len(committee), bytes(msg.selection_proof)):
-                return False
-            sets = [
-                sigsets.selection_proof_signature_set(
-                    state,
-                    int(att.data.slot),
-                    int(msg.aggregator_index),
-                    msg.selection_proof,
-                    ctx.bls,
-                    resolver,
-                    ctx.preset,
-                    ctx.spec,
-                ),
-                sigsets.aggregate_and_proof_signature_set(
-                    state, signed_aggregate, ctx.bls, resolver, ctx.preset, ctx.spec
-                ),
-                sigsets.indexed_attestation_signature_set(
-                    state,
-                    get_indexed_attestation(state, att, ctx.types, ctx.preset, ctx.spec),
-                    ctx.bls,
-                    resolver,
-                    ctx.preset,
-                    ctx.spec,
-                ),
-            ]
-        except StateTransitionError:
+        results = batch_verify_gossip_aggregates(self.chain, [signed_aggregate])
+        if results[0] is not True:
             return False
-        if not ctx.bls.verify_signature_sets(sets):
-            return False
-        self.op_pool.insert_attestation(att)
+        self.op_pool.insert_attestation(signed_aggregate.message.aggregate)
         return True
 
     # sync contributions (validator/sync_committee_contribution + POST)
@@ -587,14 +542,9 @@ def is_sync_aggregator(subcommittee_size: int, selection_proof: bytes) -> bool:
     return int.from_bytes(digest[:8], "little") % modulo == 0
 
 
-def is_aggregator(committee_length: int, selection_proof: bytes) -> bool:
-    """Spec is_aggregator: hash of the selection proof picks ~16 aggregators
-    per committee (attestation_service.rs:125-230's slot+2/3 duty)."""
-    import hashlib
-
-    modulo = max(1, committee_length // TARGET_AGGREGATORS_PER_COMMITTEE)
-    digest = hashlib.sha256(selection_proof).digest()
-    return int.from_bytes(digest[:8], "little") % modulo == 0
+# spec is_aggregator moved to state_transition.helpers (the chain-side
+# aggregate admission needs it too); re-exported here for duty services
+from ..state_transition.helpers import is_aggregator  # noqa: E402
 
 
 class ValidatorClient:

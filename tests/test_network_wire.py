@@ -297,3 +297,90 @@ def test_two_nodes_sync_over_sockets():
         assert bytes(status.head_root) == clients[0].chain.head_root
     finally:
         net.close()
+
+
+def test_aggregate_gossip_lands_in_peer_op_pool():
+    """A SignedAggregateAndProof gossiped A->B passes the three-set admission
+    on B and its inner attestation is pooled (VERDICT r4 crash repro: this
+    used to AttributeError inside the drain)."""
+    clients = [
+        Client(ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=8))
+        for _ in range(2)
+    ]
+    net = SocketNetwork(clients[0].ctx)
+    services = [NetworkService(f"node{n}", c, net) for n, c in enumerate(clients)]
+    try:
+        from lighthouse_tpu.state_transition.helpers import get_beacon_committee
+        from lighthouse_tpu.types.containers import Checkpoint
+
+        ctx = clients[0].ctx
+        chain = clients[0].chain
+        chain.slot_clock.set_slot(1)
+        clients[1].chain.slot_clock.set_slot(1)
+        state = chain.head_state()
+        committee = get_beacon_committee(state, 1, 0, ctx.preset, ctx.spec)
+        att = ctx.types.Attestation(
+            aggregation_bits=[True] * len(committee),
+            data=ctx.types.AttestationData(
+                slot=1,
+                index=0,
+                beacon_block_root=chain.head_root,
+                source=state.current_justified_checkpoint,
+                target=Checkpoint(epoch=0, root=chain.head_root),
+            ),
+            signature=b"\x00" * 96,
+        )
+        signed = ctx.types.SignedAggregateAndProof(
+            message=ctx.types.AggregateAndProof(
+                aggregator_index=committee[0],
+                aggregate=att,
+                selection_proof=b"\x11" * 96,  # committee < 16 => modulo 1
+            ),
+            signature=b"\x22" * 96,
+        )
+        services[0].publish_aggregate(signed)
+        deadline = time.time() + 5
+        while not clients[1].processor.queues and time.time() < deadline:
+            time.sleep(0.03)
+        time.sleep(0.2)
+        services[1].process_pending()
+        assert clients[1].op_pool.attestations, "aggregate should land in peer op pool"
+    finally:
+        net.close()
+
+
+def test_malformed_gossip_does_not_wedge_drain():
+    """A hostile message on the aggregate topic (wrong container shape) must
+    not abort the drain: queued work behind it still processes."""
+    client = Client(
+        ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=8)
+    )
+    from lighthouse_tpu.network import LocalNetwork
+    from lighthouse_tpu.scheduler import WorkType
+    from lighthouse_tpu.state_transition.helpers import get_beacon_committee
+    from lighthouse_tpu.types.containers import Checkpoint
+
+    net = LocalNetwork()
+    service = NetworkService("node0", client, net)
+    ctx = client.ctx
+    chain = client.chain
+    chain.slot_clock.set_slot(1)
+    state = chain.head_state()
+    committee = get_beacon_committee(state, 1, 0, ctx.preset, ctx.spec)
+    att = ctx.types.Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=ctx.types.AttestationData(
+            slot=1,
+            index=0,
+            beacon_block_root=chain.head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=0, root=chain.head_root),
+        ),
+        signature=b"\x00" * 96,
+    )
+    # hostile: a plain Attestation submitted on the AGGREGATE queue (the r4
+    # crash shape), ahead of a valid attestation in the same drain
+    client.processor.submit(WorkType.GOSSIP_AGGREGATE, att)
+    service.on_gossip(Topic.BEACON_ATTESTATION, att)
+    service.process_pending()  # must not raise
+    assert client.op_pool.attestations, "valid work behind the hostile item processed"

@@ -1,0 +1,192 @@
+"""Observed-* gossip dedup caches and their admission wiring.
+
+Mirrors /root/reference/beacon_node/beacon_chain/src/observed_attesters.rs,
+observed_aggregates.rs, observed_block_producers.rs and the admission checks
+of attestation_verification.rs:607-960.
+"""
+
+import pytest
+
+from lighthouse_tpu.chain.attestation_processing import (
+    AttestationError,
+    batch_verify_gossip_aggregates,
+    batch_verify_gossip_attestations,
+)
+from lighthouse_tpu.chain.observed import (
+    EpochTooLow,
+    ObservedAggregates,
+    ObservedAttesters,
+    ObservedBlockProducers,
+)
+from lighthouse_tpu.client import Client, ClientConfig
+from lighthouse_tpu.state_transition.helpers import get_beacon_committee
+from lighthouse_tpu.types.containers import Checkpoint
+from lighthouse_tpu.validator_client import BeaconNodeApi, ValidatorClient, ValidatorStore
+
+
+# -- unit: cache semantics -----------------------------------------------------
+
+
+def test_epoch_container_dedup_and_pruning():
+    c = ObservedAttesters()
+    assert c.observe(0, 7) is False  # first sighting
+    assert c.observe(0, 7) is True  # duplicate
+    assert c.is_observed(0, 8) is False
+    # advancing far ahead prunes old epochs and raises the floor
+    c.observe(10, 1)
+    with pytest.raises(EpochTooLow):
+        c.is_observed(0, 7)
+    assert len(c) == 1  # only epoch 10 survives
+
+
+def test_observed_aggregates_root_dedup():
+    c = ObservedAggregates()
+    assert c.observe(5, b"\x01" * 32) is False
+    assert c.observe(5, b"\x01" * 32) is True
+    assert c.is_observed(5, b"\x01" * 32)
+    c.prune(40, keep_slots=8)
+    assert c.observe(5, b"\x02" * 32) is True  # below floor: treated as seen
+
+
+def test_observed_block_producers_equivocation_and_prune():
+    c = ObservedBlockProducers()
+    assert c.observe(3, 11) is False
+    assert c.observe(3, 11) is True  # equivocation (or duplicate)
+    assert c.is_observed(3, 11)
+    c.prune(3)
+    assert c.observe(3, 12) is True  # finalized slots refuse new entries
+    assert not c.is_observed(3, 11)  # pruned
+
+
+# -- integration: admission wiring --------------------------------------------
+
+
+def _client():
+    return Client(
+        ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=8)
+    )
+
+
+def _attestation(client, slot=1, index=0):
+    ctx = client.ctx
+    state = client.chain.head_state()
+    committee = get_beacon_committee(state, slot, index, ctx.preset, ctx.spec)
+    return ctx.types.Attestation(
+        aggregation_bits=[True] * len(committee),
+        data=ctx.types.AttestationData(
+            slot=slot,
+            index=index,
+            beacon_block_root=client.chain.head_root,
+            source=state.current_justified_checkpoint,
+            target=Checkpoint(epoch=0, root=client.chain.head_root),
+        ),
+        signature=b"\x00" * 96,
+    ), committee
+
+
+def test_duplicate_gossip_attestation_is_ignored_not_reverified():
+    client = _client()
+    client.chain.slot_clock.set_slot(1)
+    att, _ = _attestation(client)
+
+    calls = []
+    real = client.ctx.bls.verify_signature_sets
+
+    def counting(sets):
+        calls.append(len(sets))
+        return real(sets)
+
+    client.ctx.bls.verify_signature_sets = counting
+    try:
+        assert batch_verify_gossip_attestations(client.chain, [att]) == [True]
+        n_after_first = len(calls)
+        (res,) = batch_verify_gossip_attestations(client.chain, [att])
+        assert isinstance(res, AttestationError)
+        assert "prior attestation known" in str(res)
+        assert len(calls) == n_after_first, "duplicate must not hit the backend"
+    finally:
+        client.ctx.bls.verify_signature_sets = real
+
+
+def test_duplicate_aggregator_is_rejected():
+    client = _client()
+    client.chain.slot_clock.set_slot(1)
+    att, committee = _attestation(client)
+    ctx = client.ctx
+
+    def make_signed(proof_byte):
+        return ctx.types.SignedAggregateAndProof(
+            message=ctx.types.AggregateAndProof(
+                aggregator_index=committee[0],
+                aggregate=att,
+                selection_proof=bytes([proof_byte]) * 96,
+            ),
+            signature=b"\x22" * 96,
+        )
+
+    assert batch_verify_gossip_aggregates(client.chain, [make_signed(0x11)]) == [True]
+    # identical aggregate root -> "aggregate already known"; different proof
+    # (same attestation data) still trips the same-root dedup first
+    (res,) = batch_verify_gossip_aggregates(client.chain, [make_signed(0x11)])
+    assert isinstance(res, AttestationError)
+
+
+def test_target_ancestry_checks():
+    client = _client()
+    client.chain.slot_clock.set_slot(1)
+    att, _ = _attestation(client)
+    # unknown target block
+    bad = att.copy() if hasattr(att, "copy") else att
+    bad.data.target = Checkpoint(epoch=0, root=b"\x42" * 32)
+    (res,) = batch_verify_gossip_attestations(client.chain, [bad])
+    assert isinstance(res, AttestationError)
+    assert "unknown target" in str(res)
+
+
+def test_second_block_from_same_proposer_rejected_on_gossip():
+    from lighthouse_tpu.network import LocalNetwork, NetworkService
+    from lighthouse_tpu.network.topics import Topic
+
+    producer = _client()
+    follower = _client()
+    net = LocalNetwork()
+    pserv = NetworkService("p", producer, net)
+    fserv = NetworkService("f", follower, net)
+
+    api = BeaconNodeApi(producer.chain, op_pool=producer.op_pool)
+    store = ValidatorStore(producer.ctx)
+    for i in range(8):
+        sk, _ = producer.ctx.bls.interop_keypair(i)
+        store.add_validator(sk)
+    vc = ValidatorClient(api, store)
+    producer.chain.slot_clock.set_slot(1)
+    follower.chain.slot_clock.set_slot(1)
+    assert vc.on_slot(1)["proposed"] is not None
+    head = producer.chain.head_root
+    blk1 = producer.chain.store.get_block(head)
+
+    # an equivocating second block: same slot + proposer, different graffiti
+    state = producer.chain.store.get_state(bytes(blk1.message.parent_root)).copy()
+    blk2_unsigned, _ = producer.chain.produce_block_on_state(
+        state,
+        int(blk1.message.slot),
+        randao_reveal=bytes(blk1.message.body.randao_reveal),
+        graffiti=b"\x77" * 32,
+    )
+    sk, _ = producer.ctx.bls.interop_keypair(int(blk1.message.proposer_index))
+    blk2 = producer.chain.sign_block(blk2_unsigned, sk)
+    r1 = type(blk1.message).hash_tree_root(blk1.message)
+    r2 = type(blk2.message).hash_tree_root(blk2.message)
+    assert r1 != r2
+
+    fserv.on_gossip(Topic.BEACON_BLOCK, blk1)
+    fserv.process_pending()
+    assert follower.chain.store.get_block(r1) is not None
+
+    fserv.on_gossip(Topic.BEACON_BLOCK, blk2)
+    fserv.process_pending()
+    assert follower.chain.store.get_block(r2) is None, "equivocation must not import"
+    # but the same block again (same root) is a harmless duplicate
+    fserv.on_gossip(Topic.BEACON_BLOCK, blk1)
+    fserv.process_pending()
+    assert follower.chain.store.get_block(r1) is not None

@@ -19,10 +19,10 @@ def test_unit_early_and_unknown_and_expiry():
     # must not grow the queue)
     assert not q.park_early("z", ready_slot=10**9, current_slot=4)
     assert q.on_slot(4) == []
-    assert q.on_slot(5) == ["a"]
+    assert [i for _, i in q.on_slot(5)] == ["a"]
     q.park_unknown_block("b", b"\x01" * 32, current_slot=3)
     q.park_unknown_block("c", b"\x02" * 32, current_slot=3)
-    assert q.on_block_imported(b"\x01" * 32) == ["b"]
+    assert [i for _, i in q.on_block_imported(b"\x01" * 32)] == ["b"]
     assert q.on_block_imported(b"\x01" * 32) == []  # released once
     # "c" expires after expiry_slots
     assert q.on_slot(4) == []

@@ -126,7 +126,12 @@ def test_processor_to_chain_pipeline(harness):
             calls.append(len(sets))
             return real(sets)
 
+    from lighthouse_tpu.chain.observed import ObservedAttesters
+
     h.chain.ctx = TransitionContext(h.ctx.types, h.ctx.spec, SpyBls())
+    # the module-scoped harness saw these attesters in the previous test;
+    # this test measures batching, not dedup
+    h.chain.observed_attesters = ObservedAttesters()
     try:
         head = h.chain.head_root
         state = h.chain.store.get_state(head)

@@ -1,0 +1,174 @@
+"""Sync machines: range sync (status-triggered), checkpoint backfill,
+segment-batched signature verification, peer rotation.
+
+Mirrors /root/reference/beacon_node/network/src/sync/manager.rs:178,
+range_sync/chain.rs, backfill_sync/mod.rs:101 and
+beacon_chain/src/historical_blocks.rs:59 at harness scale.
+"""
+
+import pytest
+
+from lighthouse_tpu.chain import BeaconChain
+from lighthouse_tpu.chain.beacon_chain import BlockError
+from lighthouse_tpu.client import Client, ClientConfig
+from lighthouse_tpu.network import LocalNetwork, NetworkService
+from lighthouse_tpu.network.socket_net import SocketNetwork
+from lighthouse_tpu.network.sync import SyncState
+from lighthouse_tpu.types import MINIMAL_PRESET
+from lighthouse_tpu.validator_client import BeaconNodeApi, ValidatorClient, ValidatorStore
+
+SLOTS = MINIMAL_PRESET.slots_per_epoch
+
+
+def _client():
+    return Client(
+        ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=8)
+    )
+
+
+def _build_chain(client, n_slots):
+    api = BeaconNodeApi(client.chain, op_pool=client.op_pool)
+    store = ValidatorStore(client.ctx)
+    for i in range(8):
+        sk, _ = client.ctx.bls.interop_keypair(i)
+        store.add_validator(sk)
+    vc = ValidatorClient(api, store)
+    for slot in range(1, n_slots + 1):
+        client.chain.slot_clock.set_slot(slot)
+        assert vc.on_slot(slot)["proposed"] is not None
+    return vc
+
+
+def test_range_sync_via_status_over_sockets():
+    """A fresh node learns a peer is ahead via status and range-syncs to its
+    head in epoch-aligned batches."""
+    producer, follower = _client(), _client()
+    net = SocketNetwork(producer.ctx)
+    pserv = NetworkService("producer", producer, net)
+    fserv = NetworkService("follower", follower, net)
+    try:
+        n = 2 * SLOTS + SLOTS // 2  # 2.5 epochs
+        _build_chain(producer, n)
+        follower.chain.slot_clock.set_slot(n)
+        follower.chain.fork_choice.on_tick(n)
+        fserv.exchange_status()
+        assert follower.chain.head_root == producer.chain.head_root
+        assert int(follower.chain.head_state().slot) == n
+        assert fserv.sync.range.batches_imported >= 2  # >1 batch exercised
+        assert fserv.sync.range.state is SyncState.IDLE
+    finally:
+        net.close()
+
+
+def test_checkpoint_backfill_to_genesis_over_sockets():
+    """A checkpoint-booted node (anchored mid-chain, no history) walks
+    backward in epoch batches, verifying each batch's proposer signatures in
+    one backend call and the hash chain block-by-block."""
+    producer, follower = _client(), _client()
+    n = 2 * SLOTS + 3
+    net = SocketNetwork(producer.ctx)
+    pserv = NetworkService("producer", producer, net)
+    _build_chain(producer, n)
+
+    # re-anchor the follower on the producer's head state (checkpoint boot)
+    ckpt_state = producer.chain.head_state().copy()
+    follower.chain = BeaconChain(ckpt_state, follower.ctx)
+    fserv = NetworkService("follower", follower, net)
+    try:
+        assert not follower.chain.backfill_complete
+        assert follower.chain.oldest_block_slot == n
+
+        calls = []
+        real = follower.ctx.bls.verify_signature_sets
+
+        def counting(sets):
+            calls.append(len(sets))
+            return real(sets)
+
+        follower.ctx.bls.verify_signature_sets = counting
+        try:
+            fserv.sync.backfill.tick()
+        finally:
+            follower.ctx.bls.verify_signature_sets = real
+
+        assert follower.chain.backfill_complete
+        assert follower.chain.oldest_block_slot == 1
+        # every block BEHIND the anchor is now stored (the anchor block
+        # itself comes from the checkpoint server at boot, not backfill)
+        for root, blk in producer.chain.store.blocks.items():
+            if int(blk.message.slot) < n:
+                assert follower.chain.store.get_block(root) is not None
+        # epoch-scale batches: each backend call covered a whole batch
+        assert calls and max(calls) >= SLOTS
+    finally:
+        net.close()
+
+
+def test_historical_batch_rejects_chain_break():
+    producer = _client()
+    n = SLOTS + 2
+    _build_chain(producer, n)
+    ckpt_state = producer.chain.head_state().copy()
+    chain = BeaconChain(ckpt_state, producer.ctx)
+    blocks = sorted(
+        producer.chain.store.blocks.values(), key=lambda b: int(b.message.slot)
+    )
+    # drop a middle block: the parent chain must break
+    tampered = blocks[:-4] + blocks[-3:]
+    with pytest.raises(BlockError):
+        chain.import_historical_block_batch(tampered)
+    assert chain.oldest_block_slot == n  # frontier untouched
+
+
+def test_chain_segment_verifies_in_one_batch():
+    """process_chain_segment: N blocks' signature sets -> ONE backend call
+    (block_verification.rs:458 signature_verify_chain_segment)."""
+    producer = _client()
+    n = SLOTS
+    _build_chain(producer, n)
+    follower = _client()
+    blocks = sorted(
+        producer.chain.store.blocks.values(), key=lambda b: int(b.message.slot)
+    )
+    calls = []
+    real = follower.ctx.bls.verify_signature_sets
+
+    def counting(sets):
+        calls.append(len(sets))
+        return real(sets)
+
+    follower.ctx.bls.verify_signature_sets = counting
+    try:
+        roots = follower.chain.process_chain_segment(blocks)
+    finally:
+        follower.ctx.bls.verify_signature_sets = real
+    assert len(roots) == n
+    assert len(calls) == 1, f"expected one batched call, got {calls}"
+    assert follower.chain.head_root == producer.chain.head_root
+
+
+def test_range_sync_rotates_away_from_dead_peer():
+    """Batch download retries on a different peer when one fails
+    (range_sync/chain.rs peer rotation)."""
+    producer, follower = _client(), _client()
+    n = SLOTS + 2
+    net = LocalNetwork()
+    pserv = NetworkService("producer", producer, net)
+    fserv = NetworkService("follower", follower, net)
+    _build_chain(producer, n)
+
+    class DeadService:
+        class client:  # noqa: N801 — attribute shim
+            chain = producer.chain
+
+        @staticmethod
+        def serve_blocks_by_range(start, count):
+            raise OSError("connection reset")
+
+    net.peers["dead"] = DeadService()
+    follower.chain.slot_clock.set_slot(n)
+    # force the rotation to meet the dead peer by trying until synced
+    from lighthouse_tpu.network.sync import SyncPeerError  # noqa: F401
+
+    fserv.sync.on_status(n)
+    assert follower.chain.head_root == producer.chain.head_root
