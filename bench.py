@@ -256,11 +256,13 @@ def child_main() -> None:
     print(json.dumps(headline))
 
 
-def _run_child(extra_env, timeout_sec, args=()):
+def _run_child(extra_env, timeout_sec, args=(), drop_env=()):
     """Run child_main in a subprocess; return the parsed last-JSON-line or None."""
     import subprocess
 
     env = dict(os.environ, **extra_env)
+    for key in drop_env:
+        env.pop(key, None)
     cmd = [sys.executable, os.path.abspath(__file__), "--child", *args]
     try:
         proc = subprocess.run(
@@ -309,8 +311,15 @@ def main() -> None:
     # Fallback: force the CPU platform (kernels persistent-cached under
     # .jax_cache, so this is minutes not hours) and record the result with
     # an explicit error field so the driver still gets a measurement.
+    # The PALLAS_AXON_* vars MUST be dropped: the ambient plugin's
+    # sitecustomize hook probes the (wedged) tunnel at import even under
+    # JAX_PLATFORMS=cpu — with the vars unset the plugin stays idle
+    # (same trick as tests/conftest.py).
     result, err = _run_child(
-        {"JAX_PLATFORMS": "cpu"}, int(os.environ.get("BENCH_CPU_TIMEOUT", 2400)), run_all
+        {"JAX_PLATFORMS": "cpu"},
+        int(os.environ.get("BENCH_CPU_TIMEOUT", 2400)),
+        run_all,
+        drop_env=("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"),
     )
     if result is not None:
         result["error"] = "; ".join(errors) + " — CPU-platform fallback measurement"

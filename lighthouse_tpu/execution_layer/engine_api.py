@@ -246,7 +246,10 @@ class ExecutionLayer:
                 err = e
                 continue
             if j is None:
-                raise EngineApiError(f"unknown payloadId {payload_id}")
+                # this engine never saw the id (another engine built it):
+                # keep trying the rest of the fallback list
+                err = EngineApiError(f"engine did not know payloadId {payload_id}")
+                continue
             return json_to_payload(t, j)
         raise EngineApiError(f"all engines failed: {err}")
 

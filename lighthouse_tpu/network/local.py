@@ -25,17 +25,6 @@ class LocalNetwork:
             if node_id != from_id:
                 service.on_gossip(topic, message)
 
-    def blocks_by_range(self, requester_id: str, start_slot: int, count: int):
-        """Req/Resp BlocksByRange served by the first peer that can
-        (rpc/protocol.rs BlocksByRange; sync/range_sync)."""
-        for node_id, service in self.peers.items():
-            if node_id == requester_id:
-                continue
-            blocks = service.serve_blocks_by_range(start_slot, count)
-            if blocks:
-                return blocks
-        return []
-
     # -- per-peer surface for the sync machines --------------------------------
 
     def peer_ids(self, requester_id: str) -> list[str]:

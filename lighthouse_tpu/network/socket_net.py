@@ -74,26 +74,6 @@ class SocketNetwork:
         ssz = self._encode(topic, message)
         entry["gossip"].publish(topic.full_name(digest, subnet), ssz)
 
-    def blocks_by_range(self, requester_id: str, start_slot: int, count: int):
-        if count <= 0:
-            return []
-        req = rpc.BlocksByRangeRequest(start_slot=start_slot, count=count, step=1)
-        with self._lock:
-            others = [
-                (nid, e["rpc"].addr) for nid, e in self._nodes.items() if nid != requester_id
-            ]
-        for _nid, addr in others:
-            try:
-                chunks = rpc.request(addr, rpc.Protocol.BLOCKS_BY_RANGE, req)
-            except (OSError, RuntimeError, ValueError):
-                continue
-            if chunks:
-                return [
-                    decode_signed_block(c, self.ctx.types, self.ctx.spec, self.ctx.preset)
-                    for c in chunks
-                ]
-        return []
-
     def peer_ids(self, requester_id: str) -> list[str]:
         with self._lock:
             return [nid for nid in self._nodes if nid != requester_id]

@@ -74,20 +74,24 @@ def _download_and_import(service, rotation: _PeerRotation, batch: Batch, importe
     for the same reason).
 
     ExecutionEngineError raised by `importer` propagates: an EL outage is
-    our fault, not the peer's, and must not burn peer attempts."""
+    our fault, not the peer's, and must not burn peer attempts.
+
+    Empty answers do NOT count against MAX_BATCH_ATTEMPTS (they are a
+    verdict, not a failure) — every live peer gets polled before the
+    all-empty acceptance is decided."""
     empty_peers: set[str] = set()
     while batch.attempts < MAX_BATCH_ATTEMPTS:
         peers = service.network.peer_ids(service.node_id)
         peer = rotation.pick(peers, batch)
         if peer is None:
             break
-        batch.attempts += 1
         try:
             blocks = service.network.blocks_by_range_from(
                 service.node_id, peer, batch.start_slot, batch.count
             )
         except SyncPeerError:
             batch.failed_peers.add(peer)
+            batch.attempts += 1
             continue
         if not blocks:
             empty_peers.add(peer)
@@ -96,6 +100,7 @@ def _download_and_import(service, rotation: _PeerRotation, batch: Batch, importe
         if importer(peer, blocks):
             return True
         batch.failed_peers.add(peer)
+        batch.attempts += 1
     live = set(service.network.peer_ids(service.node_id))
     return bool(live) and live <= empty_peers
 

@@ -529,7 +529,10 @@ class BeaconNodeApi:
         return root
 
 
-TARGET_AGGREGATORS_PER_COMMITTEE = 16
+from ..state_transition.helpers import (  # noqa: E402
+    TARGET_AGGREGATORS_PER_COMMITTEE,
+)
+
 TARGET_AGGREGATORS_PER_SYNC_SUBCOMMITTEE = 16
 
 

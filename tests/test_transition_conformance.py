@@ -31,3 +31,23 @@ def test_vector_inventory():
 )
 def test_transition_case(case):
     run_transition_case(case)
+
+
+def test_pinned_kat_roots():
+    """Every generated case's post-state root must equal the value pinned in
+    round 5 (conformance/kat_roots.py) — the external-truth anchor that
+    detects spec drift instead of reproducing it. A deliberately injected
+    spec bug changes a handler's output root and fails here."""
+    from lighthouse_tpu.conformance.kat_roots import PINNED_POST_ROOTS
+    from lighthouse_tpu.conformance.transition_cases import generate_transition_cases
+
+    got = {
+        f"{c.runner}/{c.handler}/{c.fork}/{c.name}": c.post_root.hex()
+        for c in generate_transition_cases()
+        if c.post_root is not None
+    }
+    assert set(got) == set(PINNED_POST_ROOTS), (
+        "case set changed; re-pin kat_roots.py deliberately"
+    )
+    diffs = {k: (got[k], PINNED_POST_ROOTS[k]) for k in got if got[k] != PINNED_POST_ROOTS[k]}
+    assert not diffs, f"post-state roots drifted from pinned values: {diffs}"
