@@ -258,6 +258,21 @@ class _Handler(BaseHTTPRequestHandler):
             ci = int(q["committee_index"][0])
             data = api.attestation_data(slot, ci)
             self._send(200, _data(encode(data, type(data))))
+        elif parts == ["eth", "v1", "validator", "aggregate_attestation"]:
+            slot = int(q["slot"][0])
+            ci = int(q["committee_index"][0])
+            agg = api.get_aggregate(slot, ci)
+            if agg is None:
+                raise ApiError(404, "no aggregate available")
+            self._send(200, _data(encode(agg, type(agg))))
+        elif parts == ["eth", "v1", "validator", "sync_committee_contribution"]:
+            slot = int(q["slot"][0])
+            sub = int(q["subcommittee_index"][0])
+            root = bytes.fromhex(q["beacon_block_root"][0].removeprefix("0x"))
+            contribution = api.produce_sync_contribution(slot, root, sub)
+            if contribution is None:
+                raise ApiError(404, "no contribution available")
+            self._send(200, _data(encode(contribution, type(contribution))))
         elif len(parts) == 5 and parts[:4] == ["eth", "v2", "validator", "blocks"]:
             slot = int(parts[4])
             reveal = bytes.fromhex(q["randao_reveal"][0].removeprefix("0x"))
@@ -344,24 +359,28 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001
             self._error(500, f"{type(e).__name__}: {e}")
 
+    def _publish_batch(self, body, ssz_type, publish_fn, noun: str) -> None:
+        """Shared pool-POST shape: decode each item, publish, report
+        per-index failures with a 400 (the Beacon API batch convention)."""
+        failures = []
+        for i, obj in enumerate(body):
+            if not publish_fn(decode(obj, ssz_type)):
+                failures.append({"index": i, "message": f"{noun} rejected"})
+        if failures:
+            self._send(
+                400,
+                json.dumps(
+                    {"code": 400, "message": f"some {noun}s failed", "failures": failures}
+                ).encode(),
+            )
+        else:
+            self._send(200, b"{}")
+
     def _route_post(self, parts, body):
         api, ctx = self.api, self.chain.ctx
         t = ctx.types
         if parts == ["eth", "v1", "beacon", "pool", "attestations"]:
-            failures = []
-            for i, obj in enumerate(body):
-                att = decode(obj, t.Attestation)
-                if not api.publish_attestation(att):
-                    failures.append({"index": i, "message": "attestation rejected"})
-            if failures:
-                self._send(
-                    400,
-                    json.dumps(
-                        {"code": 400, "message": "some attestations failed", "failures": failures}
-                    ).encode(),
-                )
-            else:
-                self._send(200, b"{}")
+            self._publish_batch(body, t.Attestation, api.publish_attestation, "attestation")
         elif parts == ["eth", "v1", "beacon", "blocks"]:
             slot = int(body["message"]["slot"])
             fork = ctx.spec.fork_name_at_epoch(slot // ctx.preset.slots_per_epoch)
@@ -369,20 +388,17 @@ class _Handler(BaseHTTPRequestHandler):
             root = api.publish_block(signed)
             self._send(200, json.dumps({"data": {"root": "0x" + root.hex()}}).encode())
         elif parts == ["eth", "v1", "beacon", "pool", "sync_committees"]:
-            failures = []
-            for i, obj in enumerate(body):
-                msg = decode(obj, t.SyncCommitteeMessage)
-                if not api.publish_sync_message(msg):
-                    failures.append({"index": i, "message": "sync message rejected"})
-            if failures:
-                self._send(
-                    400,
-                    json.dumps(
-                        {"code": 400, "message": "some messages failed", "failures": failures}
-                    ).encode(),
-                )
-            else:
-                self._send(200, b"{}")
+            self._publish_batch(
+                body, t.SyncCommitteeMessage, api.publish_sync_message, "sync message"
+            )
+        elif parts == ["eth", "v1", "validator", "aggregate_and_proofs"]:
+            self._publish_batch(
+                body, t.SignedAggregateAndProof, api.publish_aggregate, "aggregate"
+            )
+        elif parts == ["eth", "v1", "validator", "contribution_and_proofs"]:
+            self._publish_batch(
+                body, t.SignedContributionAndProof, api.publish_contribution, "contribution"
+            )
         elif len(parts) == 6 and parts[:5] == ["eth", "v1", "validator", "duties", "sync"]:
             epoch = int(parts[5])
             state = self.chain.head_state()

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 from ..chain.attestation_processing import (
     AttestationError,
+    PipelinedGossipVerifier,
     batch_verify_gossip_aggregates,
-    batch_verify_gossip_attestations,
 )
 from ..chain.beacon_chain import BlockError
 from ..state_transition import ExecutionEngineError
@@ -127,21 +127,24 @@ class NetworkService:
                     for wt, att in self.reprocess.on_block_imported(root):
                         p.submit(wt, att)
 
+        # attestation batches are SUBMITTED during the drain and their
+        # verdicts collected afterwards: host staging of batch i+1 overlaps
+        # device execution of batch i (PipelinedGossipVerifier)
+        verifier = PipelinedGossipVerifier(chain)
+
+        def route_attestation(att, ok):
+            if ok is True:
+                self.client.op_pool.insert_attestation(att)
+            elif isinstance(ok, AttestationError) and "unknown head block" in str(ok):
+                self.reprocess.park_unknown_block(
+                    att, bytes(att.data.beacon_block_root), current_slot
+                )
+            elif isinstance(ok, AttestationError) and "future slot" in str(ok):
+                # early arrival: park until its slot starts (bounded)
+                self.reprocess.park_early(att, int(att.data.slot), current_slot)
+
         def handle_atts(items):
-            results = batch_verify_gossip_attestations(chain, items)
-            for att, ok in zip(items, results):
-                if ok is True:
-                    self.client.op_pool.insert_attestation(att)
-                elif (
-                    isinstance(ok, AttestationError)
-                    and "unknown head block" in str(ok)
-                ):
-                    self.reprocess.park_unknown_block(
-                        att, bytes(att.data.beacon_block_root), current_slot
-                    )
-                elif isinstance(ok, AttestationError) and "future slot" in str(ok):
-                    # early arrival: park until its slot starts (bounded)
-                    self.reprocess.park_early(att, int(att.data.slot), current_slot)
+            verifier.submit(items)
 
         def handle_aggs(items):
             # SignedAggregateAndProofs: three-set admission per aggregate,
@@ -182,6 +185,9 @@ class NetworkService:
                 WorkType.GOSSIP_AGGREGATE: isolated(handle_aggs),
             }
         )
+        # collect the in-flight attestation verdicts (route callbacks may
+        # park items for reprocessing on a later call)
+        verifier.flush(route_attestation)
 
     def _range_sync(self, orphan_block) -> None:
         """Unknown-parent trigger: hand the gap to the SyncManager

@@ -25,13 +25,26 @@ from .memory import Store
 
 
 class HotColdDB(Store):
-    def __init__(self, ctx, path: str | None = None, slots_per_restore_point: int = 32):
+    def __init__(
+        self,
+        ctx,
+        path: str | None = None,
+        slots_per_restore_point: int = 32,
+        hot_state_interval: int | None = None,
+    ):
         self.ctx = ctx
         self.sprp = slots_per_restore_point
+        # hot-state thinning (hot_cold_store.rs HotStateSummary): full
+        # states persist only at epoch boundaries; everything between
+        # reconstructs by replaying blocks from the previous boundary
+        self.hot_interval = hot_state_interval or ctx.preset.slots_per_epoch
+        # in-memory cache bound: the snapshot-cache role (snapshot_cache.rs)
+        self.max_cached = 4 * self.hot_interval
         self.path = pathlib.Path(path) if path else None
         self.blocks: dict[bytes, object] = {}
         self.hot_states: dict[bytes, object] = {}
         self.cold_states: dict[bytes, object] = {}  # restore points only
+        self._persisted_hot: set[bytes] = set()  # roots with a states/ file
         self.block_parent: dict[bytes, bytes] = {}
         self.block_slot: dict[bytes, int] = {}
         self.meta: dict = {}
@@ -59,11 +72,18 @@ class HotColdDB(Store):
     def put_state(self, root: bytes, state) -> None:
         root = bytes(root)
         self.hot_states[root] = state
-        if self.path:
+        # persist the full state only at hot-summary boundaries — or when
+        # it is an ANCHOR (genesis / checkpoint state with no stored block:
+        # nothing to replay from, it must survive a restart verbatim)
+        boundary = int(state.slot) % self.hot_interval == 0
+        anchor = root not in self.blocks
+        if self.path and (boundary or anchor):
             self._write(
                 self.path / "states" / f"{root.hex()}.ssz",
                 type(state).serialize(state),
             )
+            self._persisted_hot.add(root)
+        self._evict()
 
     def get_state(self, root: bytes):
         root = bytes(root)
@@ -71,6 +91,21 @@ class HotColdDB(Store):
         if got is not None:
             return got
         return self._reconstruct(root)
+
+    def _evict(self) -> None:
+        """Bound the in-memory hot cache: drop the oldest non-boundary,
+        non-anchor states beyond capacity (they reconstruct by replay)."""
+        if len(self.hot_states) <= self.max_cached:
+            return
+        by_age = sorted(self.hot_states.items(), key=lambda kv: int(kv[1].slot))
+        excess = len(self.hot_states) - self.max_cached
+        for root, state in by_age:
+            if excess <= 0:
+                break
+            if int(state.slot) % self.hot_interval == 0 or root not in self.blocks:
+                continue
+            del self.hot_states[root]
+            excess -= 1
 
     def __len__(self) -> int:
         return len(self.blocks)
@@ -86,17 +121,28 @@ class HotColdDB(Store):
         if fin_state is None:
             return
         fin_slot = int(fin_state.slot)
-        for root, state in list(self.hot_states.items()):
-            slot = int(state.slot)
+        candidates = set(self.hot_states) | set(self._persisted_hot)
+        for root in candidates:
+            state = self.hot_states.get(root)
+            slot = int(state.slot) if state is not None else self.block_slot.get(root)
+            if slot is None:
+                continue  # anchor with no block record: keep
             if slot >= fin_slot and root != finalized_root:
                 continue  # still hot
-            del self.hot_states[root]
+            self.hot_states.pop(root, None)
             if slot % self.sprp == 0 or root == finalized_root:
-                self.cold_states[root] = state
+                if state is None:
+                    state = self.get_state(root)
+                if state is not None:
+                    self.cold_states[root] = state
+                # the disk file stays (restore points reload on resume) but
+                # later migrates must not revisit this root
+                self._persisted_hot.discard(root)
             elif self.path:
                 p = self.path / "states" / f"{root.hex()}.ssz"
                 if p.exists():
                     p.unlink()  # reconstructable: drop from disk too
+                self._persisted_hot.discard(root)
         self.meta["finalized_root"] = finalized_root.hex()
         self._write_meta()
 
@@ -179,6 +225,6 @@ class HotColdDB(Store):
             self.block_parent[root] = bytes(signed.message.parent_root)
             self.block_slot[root] = int(signed.message.slot)
         for p in (self.path / "states").glob("*.ssz"):
-            self.hot_states[bytes.fromhex(p.stem)] = decode_beacon_state(
-                p.read_bytes(), t, self.ctx.spec
-            )
+            root = bytes.fromhex(p.stem)
+            self.hot_states[root] = decode_beacon_state(p.read_bytes(), t, self.ctx.spec)
+            self._persisted_hot.add(root)

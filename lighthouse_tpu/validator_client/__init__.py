@@ -2,6 +2,7 @@
 attestation/block services over the beacon-node API seam.
 """
 
+from .http_client import BeaconApiError, BeaconNodeHttpClient
 from .slashing_protection import SlashingDatabase, SlashingProtectionError
 from .validator_client import (
     AttesterDuty,
@@ -11,6 +12,8 @@ from .validator_client import (
 )
 
 __all__ = [
+    "BeaconApiError",
+    "BeaconNodeHttpClient",
     "SlashingDatabase",
     "SlashingProtectionError",
     "AttesterDuty",

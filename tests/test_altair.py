@@ -208,6 +208,7 @@ def ref_altair_harness():
     return BeaconChainHarness(8, ctx)
 
 
+@pytest.mark.slow
 def test_altair_blocks_bulk_verify_ref(ref_altair_harness):
     h = ref_altair_harness
     h.extend_chain(SLOTS + 2, strategy=BlockSignatureStrategy.VERIFY_BULK)
@@ -249,6 +250,7 @@ def test_tampered_sync_aggregate_rejected_ref(ref_altair_harness):
     chain.process_block(signed2, strategy=BlockSignatureStrategy.VERIFY_BULK)
 
 
+@pytest.mark.slow
 def test_vc_proposes_and_attests_across_fork_boundary_ref():
     """The VC signs with schedule-derived domains; at altair's first slot the
     head state still carries the phase0 fork record, so state-derived domains

@@ -162,18 +162,23 @@ def test_native_merkleize_speedup_on_validator_plane():
     from lighthouse_tpu import native
 
     chunks = [bytes([i % 256]) * 32 for i in range(4096)]
-    t0 = _t.perf_counter()
-    native_root = sszh.merkleize(chunks)
-    t_native = _t.perf_counter() - t0
 
+    def best_of(fn, n=3):
+        times = []
+        for _ in range(n):
+            t0 = _t.perf_counter()
+            result = fn()
+            times.append(_t.perf_counter() - t0)
+        return result, min(times)
+
+    native_root, t_native = best_of(lambda: sszh.merkleize(chunks))
     old = sszh._NATIVE_MIN_CHUNKS
     sszh._NATIVE_MIN_CHUNKS = 10**9  # force python path
     try:
-        t0 = _t.perf_counter()
-        py_root = sszh.merkleize(chunks)
-        t_py = _t.perf_counter() - t0
+        py_root, t_py = best_of(lambda: sszh.merkleize(chunks))
     finally:
         sszh._NATIVE_MIN_CHUNKS = old
     assert native_root == py_root
-    # speed assertion deliberately loose (CI noise): native must not be slower
-    assert t_native <= t_py * 1.5
+    # speed assertion deliberately loose (best-of-3, 2x headroom): a loaded
+    # CI box must not flake this, only a real native regression should
+    assert t_native <= t_py * 2.0

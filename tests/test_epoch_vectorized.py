@@ -13,6 +13,8 @@ import random
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
 from lighthouse_tpu.state_transition.altair import (
     PARTICIPATION_FLAG_WEIGHTS,

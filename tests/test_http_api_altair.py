@@ -12,6 +12,10 @@ import urllib.request
 
 import pytest
 
+# ref-backend module (real signing in the fixture): nightly tier.
+# Default-tier HTTP coverage lives in test_vc_http.py / test_http_api.py.
+pytestmark = pytest.mark.slow
+
 from lighthouse_tpu.chain import BeaconChain
 from lighthouse_tpu.http_api import HttpApiServer, decode, encode
 from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state

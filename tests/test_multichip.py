@@ -9,6 +9,8 @@ identical to the single-device kernel and to the oracle's verdict.
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow
+
 import jax
 import jax.numpy as jnp
 

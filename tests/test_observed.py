@@ -190,3 +190,34 @@ def test_second_block_from_same_proposer_rejected_on_gossip():
     fserv.on_gossip(Topic.BEACON_BLOCK, blk1)
     fserv.process_pending()
     assert follower.chain.store.get_block(r1) is not None
+
+
+def test_pipelined_cross_batch_dedup():
+    """Duplicates split across batches submitted in ONE drain cycle are
+    dropped before hitting the backend (the provisional-observation guard:
+    the global cache only updates at flush)."""
+    from lighthouse_tpu.chain.attestation_processing import PipelinedGossipVerifier
+
+    client = _client()
+    client.chain.slot_clock.set_slot(1)
+    att, _ = _attestation(client)
+
+    calls = []
+    real = client.ctx.bls.verify_signature_sets
+
+    def counting(sets):
+        calls.append(len(sets))
+        return real(sets)
+
+    client.ctx.bls.verify_signature_sets = counting
+    try:
+        v = PipelinedGossipVerifier(client.chain)
+        v.submit([att])
+        v.submit([att])  # second batch, same attestation, same drain
+        outcomes = []
+        v.flush(lambda a, res: outcomes.append(res))
+    finally:
+        client.ctx.bls.verify_signature_sets = real
+    assert outcomes[0] is True
+    assert isinstance(outcomes[1], AttestationError)
+    assert sum(calls) == 1, f"duplicate must not reach the backend: {calls}"

@@ -49,6 +49,7 @@ def altair_vc(backend="ref", n=8, doppelganger=None):
 # -- sync committee service ----------------------------------------------------
 
 
+@pytest.mark.slow
 def test_vc_sync_messages_flow_into_next_block_ref():
     ctx, chain, vc = altair_vc("ref")
     s1 = vc.on_slot(1)
@@ -66,6 +67,7 @@ def test_vc_sync_messages_flow_into_next_block_ref():
     assert bytes(agg.sync_committee_signature) != G2_POINT_AT_INFINITY
 
 
+@pytest.mark.slow
 def test_bad_sync_message_rejected_ref():
     ctx, chain, vc = altair_vc("ref")
     msg = ctx.types.SyncCommitteeMessage(
@@ -132,6 +134,7 @@ def test_doppelganger_detection_via_chain_observation():
     assert not d.allows_signing(detected_index, 100)
 
 
+@pytest.mark.slow
 def test_sync_contribution_flow_ref():
     """Aggregators produce per-subcommittee SignedContributionAndProofs that
     verify (three-set batch) and fold into a SECOND node's pool — the gossip
@@ -201,6 +204,7 @@ def test_sync_contribution_flow_ref():
 # -- aggregation duty ----------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_aggregation_duty_produces_verified_aggregates_ref():
     ctx, chain, vc = altair_vc("ref")
     chain.slot_clock.set_slot(1)

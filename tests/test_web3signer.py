@@ -9,6 +9,8 @@ import dataclasses
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from lighthouse_tpu.chain.beacon_chain import BeaconChain
 from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
 from lighthouse_tpu.types import MINIMAL_PRESET, MINIMAL_SPEC
