@@ -120,24 +120,26 @@ class PipelinedGossipVerifier:
         self.chain = chain
         self.apply_to_fork_choice = apply_to_fork_choice
         self._pending = []  # (items, results, staged, future|None)
-        # (epoch, validator) pairs staged this cycle but not yet globally
-        # observed (global marking happens only after signature success, as
-        # in the reference): keeps the PriorAttestationKnown dedup effective
-        # ACROSS batches submitted in one drain, where the global cache has
-        # not been updated yet
-        self._provisional: set[tuple[int, int]] = set()
+        # roots of attestations staged this cycle but not yet resolved:
+        # IDENTICAL duplicates across batches in one drain are dropped
+        # without re-verification, while a different attestation from the
+        # same validator still verifies (global observed-marking happens
+        # only after signature success, as in the reference — keying this
+        # on (epoch, validator) would let one bad-signature copy suppress
+        # the validator's real attestation)
+        self._provisional: set[bytes] = set()
 
     def submit(self, attestations) -> None:
         results, staged = _stage_gossip_attestations(self.chain, attestations)
         kept = []
         for row in staged:
-            i, indexed, _ = row
-            epoch = int(indexed.data.target.epoch)
-            keys = [(epoch, int(vi)) for vi in indexed.attesting_indices]
-            if all(k in self._provisional for k in keys):
+            i, _indexed, _ = row
+            att = attestations[i]
+            root = type(att).hash_tree_root(att)
+            if root in self._provisional:
                 results[i] = AttestationError("prior attestation known")
                 continue
-            self._provisional.update(keys)
+            self._provisional.add(root)
             kept.append(row)
         staged = kept
         future = None

@@ -142,19 +142,41 @@ class ReqRespServer:
     mirror the worker-side RPC methods (network/src/router/processor.rs).
     """
 
-    def __init__(self, node, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, node, host: str = "127.0.0.1", port: int = 0, peer_db=None):
+        from .peer_manager import PENALTY_RATE_LIMITED, RateLimiter
+
         self.node = node
+        self.rate_limiter = RateLimiter()
+        self.peer_db = peer_db
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
-                    proto = _recv_frame(self.request, cap=1024).decode()
+                    header = _recv_frame(self.request, cap=1024).decode()
+                    # header: protocol id, optionally "\n" + requester node
+                    # id (the logical identity libp2p's PeerId provides —
+                    # per-IP keying would pool every localhost-simulator
+                    # node into one bucket)
+                    proto, _, peer_id = header.partition("\n")
+                    peer_id = peer_id or self.client_address[0]
+                    # token-bucket quota per (peer, protocol)
+                    # (rpc/rate_limiter.rs:59): over-quota streams drop and
+                    # the peer manager hears about it
+                    # /eth2/beacon_chain/req/<name>/1/ssz_snappy
+                    short = proto.strip("/").split("/")
+                    name = short[3] if len(short) > 3 else proto
+                    if outer.peer_db is not None and not outer.peer_db.is_usable(peer_id):
+                        return  # graylisted requester: ignored (peerdb.rs)
+                    if not outer.rate_limiter.allow(peer_id, name):
+                        if outer.peer_db is not None:
+                            outer.peer_db.penalize(peer_id, PENALTY_RATE_LIMITED)
+                        return
                     body = _recv_frame(self.request)
                     for chunk in outer._dispatch(proto, body):
                         _send_frame(self.request, chunk)
                 except (ConnectionError, ValueError, OSError):
-                    pass  # malformed peer: drop the stream (rate limiter role)
+                    pass  # malformed peer: drop the stream
 
         class Server(socketserver.ThreadingTCPServer):
             daemon_threads = True
@@ -233,13 +255,16 @@ class ReqRespServer:
 # -- client --------------------------------------------------------------------
 
 
-def request(addr, protocol: str, req_obj=None, timeout: float = 10.0) -> list[bytes]:
-    """One RPC: connect, send protocol id + request, read SUCCESS chunks to
-    EOF. Returns the decoded SSZ payloads; raises on an error result byte."""
+def request(addr, protocol: str, req_obj=None, timeout: float = 10.0, node_id: str = "") -> list[bytes]:
+    """One RPC: connect, send protocol id (+ requester identity) + request,
+    read SUCCESS chunks to EOF. Returns the decoded SSZ payloads; raises on
+    an error result byte. `node_id` identifies the requester to the
+    server's rate limiter / peer manager (the PeerId libp2p would supply)."""
     req_type = REQUEST_TYPES[protocol]
     body = b"" if req_obj is None else req_type.serialize(req_obj)
     with socket.create_connection(addr, timeout=timeout) as sock:
-        _send_frame(sock, protocol.encode())
+        header = protocol + ("\n" + node_id if node_id else "")
+        _send_frame(sock, header.encode())
         _send_frame(sock, encode_payload(body) if req_type is not None else b"")
         sock.shutdown(socket.SHUT_WR)
         chunks = []

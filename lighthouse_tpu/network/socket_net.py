@@ -36,10 +36,21 @@ class SocketNetwork:
     # -- LocalNetwork interface ------------------------------------------------
 
     def register(self, node_id: str, service) -> None:
+        from .peer_manager import PeerDB
+
+        peer_db = PeerDB()  # shared score book: gossip + req/resp
+        box: list = []  # late-bound: the deliver closure needs the node
         gossip = GossipNode(
-            deliver=lambda topic, payload: self._deliver(service, topic, payload)
+            deliver=lambda topic, payload, src: self._deliver(
+                service, box[0], topic, payload, src
+            ),
+            peer_db=peer_db,
+            node_id=node_id,
         )
-        server = rpc.ReqRespServer(_RpcNode(service.client.chain)).start()
+        box.append(gossip)
+        server = rpc.ReqRespServer(
+            _RpcNode(service.client.chain), peer_db=peer_db
+        ).start()
         with self._lock:
             for entry in self._nodes.values():
                 gossip.connect(entry["gossip"].addr)  # full mesh
@@ -47,6 +58,7 @@ class SocketNetwork:
                 "service": service,
                 "gossip": gossip,
                 "rpc": server,
+                "peer_db": peer_db,
             }
 
     def publish(self, from_id: str, topic: Topic, message) -> None:
@@ -91,7 +103,9 @@ class SocketNetwork:
             raise SyncPeerError(f"unknown peer {peer_id}")
         req = rpc.BlocksByRangeRequest(start_slot=start_slot, count=count, step=1)
         try:
-            chunks = rpc.request(entry["rpc"].addr, rpc.Protocol.BLOCKS_BY_RANGE, req)
+            chunks = rpc.request(
+                entry["rpc"].addr, rpc.Protocol.BLOCKS_BY_RANGE, req, node_id=requester_id
+            )
         except (OSError, RuntimeError, ValueError) as e:
             raise SyncPeerError(f"peer {peer_id}: {e}") from e
         return [
@@ -103,7 +117,7 @@ class SocketNetwork:
         """Status handshake from node_id's view of peer_id (rpc status)."""
         me = self._nodes[node_id]
         peer_addr = self._nodes[peer_id]["rpc"].addr
-        chunks = rpc.request(peer_addr, rpc.Protocol.STATUS, me["rpc"].status())
+        chunks = rpc.request(peer_addr, rpc.Protocol.STATUS, me["rpc"].status(), node_id=node_id)
         return rpc.StatusMessage.deserialize(chunks[0])
 
     def close(self) -> None:
@@ -145,14 +159,16 @@ class SocketNetwork:
             self._digest_cache[gvr] = cached
         return cached
 
-    def _deliver(self, service, topic_name: str, payload: bytes) -> None:
+    def _deliver(self, service, gossip, topic_name: str, payload: bytes, src: str) -> None:
         # /eth2/{digest}/{name}[_{subnet}]/ssz_snappy
         parts = topic_name.strip("/").split("/")
         if len(parts) != 4 or parts[0] != "eth2" or parts[3] != "ssz_snappy":
+            gossip.report_invalid_message(src)
             return
         try:
             digest = bytes.fromhex(parts[1])
         except ValueError:
+            gossip.report_invalid_message(src)
             return
         parsed = Topic.parse_wire_name(parts[2])
         if parsed is None:
@@ -162,6 +178,9 @@ class SocketNetwork:
             return  # unknown fork digest: not subscribed (types/topics.rs)
         try:
             obj = self._decode(topic, payload)
-        except Exception:  # noqa: BLE001 — malformed gossip drops
+        except Exception:  # noqa: BLE001 — malformed gossip: drop + score
+            # the forwarder relayed an undecodable container
+            # (gossip_methods.rs reject -> report_peer)
+            gossip.report_invalid_message(src)
             return
         service.on_gossip(topic, obj)

@@ -1,0 +1,184 @@
+"""Gossipsub mesh semantics, lazy IHAVE/IWANT gossip, peer scoring, and
+RPC rate limiting.
+
+Mirrors /root/reference/beacon_node/lighthouse_network/src/behaviour/
+gossipsub_scoring_parameters.rs:27, peer_manager/mod.rs:61 + peerdb.rs, and
+rpc/rate_limiter.rs:59 at harness scale.
+"""
+
+import time
+
+from lighthouse_tpu.network.gossip import GossipNode, encode_control, message_id
+from lighthouse_tpu.network.peer_manager import (
+    BAN_THRESHOLD,
+    GRAYLIST_THRESHOLD,
+    PeerDB,
+    RateLimiter,
+)
+
+
+def _mesh_net(n, d=2, d_low=1, d_high=3, d_lazy=2):
+    """n fully-connected nodes with a small mesh degree so mesh < peers."""
+    delivered = [[] for _ in range(n)]
+    nodes = []
+    for i in range(n):
+        node = GossipNode(
+            deliver=(lambda i: lambda t, p, src: delivered[i].append(p))(i),
+            d=d, d_low=d_low, d_high=d_high, d_lazy=d_lazy,
+            heartbeat=False,  # tests drive heartbeat() deterministically
+        )
+        for other in nodes:
+            node.connect(other.addr)
+        nodes.append(node)
+    time.sleep(0.2)  # let accept loops register the inbound sockets
+    return nodes, delivered
+
+
+def _close(nodes):
+    for n in nodes:
+        n.close()
+
+
+def test_mesh_bounded_and_message_reaches_all():
+    """With degree D=2 over 6 fully-connected nodes, the mesh stays bounded
+    and messages still reach everyone (eagerly or via IHAVE/IWANT)."""
+    nodes, delivered = _mesh_net(6)
+    try:
+        nodes[0].publish("/eth2/00000000/beacon_block/ssz_snappy", b"payload-1")
+        deadline = time.time() + 5
+        def all_got():
+            return all(d and d[0] == b"payload-1" for d in delivered[1:])
+        while not all_got() and time.time() < deadline:
+            for nd in nodes:
+                nd.heartbeat()  # IHAVE round + mesh upkeep
+            time.sleep(0.05)
+        assert all_got(), f"delivery: {[len(d) for d in delivered]}"
+        for nd in nodes:
+            for topic, mesh in nd._mesh.items():
+                assert len(mesh) <= nd.d_high, f"mesh over D_HIGH: {len(mesh)}"
+    finally:
+        _close(nodes)
+
+
+def test_iwant_pulls_from_mcache():
+    """A node that only hears an IHAVE advertisement pulls the message."""
+    nodes, delivered = _mesh_net(2, d=1, d_low=1, d_high=1, d_lazy=1)
+    a, b = nodes
+    try:
+        payload = b"lazy-message"
+        a.publish("/eth2/00000000/beacon_block/ssz_snappy", payload)
+        # whether or not b was in a's mesh, after a heartbeat + pull rounds
+        # b must have the payload
+        deadline = time.time() + 5
+        while not delivered[1] and time.time() < deadline:
+            a.heartbeat()
+            b.heartbeat()
+            time.sleep(0.05)
+        assert delivered[1] == [payload]
+        assert message_id(payload) in a._mcache
+    finally:
+        _close(nodes)
+
+
+def test_protocol_violation_scores_and_bans():
+    """Garbage frames penalize the sender; enough of them ban + disconnect."""
+    nodes, _ = _mesh_net(2)
+    a, b = nodes
+    try:
+        # b sends garbage data frames to a by writing raw junk
+        import socket as _s
+
+        sock = _s.create_connection(a.addr, timeout=5)
+        from lighthouse_tpu.network.rpc import _send_frame
+
+        for _ in range(3):  # 2 * PENALTY_PROTOCOL_VIOLATION reaches BAN(-8)
+            try:
+                _send_frame(sock, b"\x00garbage-not-snappy")
+            except OSError:
+                break  # already disconnected by the ban
+            time.sleep(0.05)
+        time.sleep(0.3)
+        pid = "%s:%d" % sock.getsockname()
+        rec = a.peer_db.record(pid)
+        assert rec.score <= GRAYLIST_THRESHOLD
+        # the banned peer was disconnected: its socket left a's peer table
+        assert all(a._peer_id(p) != pid for p in a._peers)
+    finally:
+        _close(nodes)
+
+
+def test_graylisted_graft_gets_pruned():
+    nodes, _ = _mesh_net(2)
+    a, b = nodes
+    try:
+        # find a's socket for peer b and graylist it
+        time.sleep(0.1)
+        peer_sock = next(iter(a._peers))
+        pid = a._peer_id(peer_sock)
+        a.peer_db.penalize(pid, -GRAYLIST_THRESHOLD + 1)  # push below graylist
+        assert not a.peer_db.is_usable(pid)
+        # a graft from that peer is rejected (not added to mesh)
+        a._on_control(encode_control({"graft": ["topic-x"]}), peer_sock)
+        assert peer_sock not in a._mesh.get("topic-x", set())
+    finally:
+        _close(nodes)
+
+
+def test_broken_iwant_promise_penalized():
+    nodes, _ = _mesh_net(2)
+    a, b = nodes
+    try:
+        time.sleep(0.1)
+        peer_sock = next(iter(a._peers))
+        pid = a._peer_id(peer_sock)
+        # peer advertises an id it will never deliver
+        a._on_control(
+            encode_control({"ihave": {"t": ["ab" * 20]}}), peer_sock
+        )
+        assert a._promises
+        # expire the promise
+        mid = next(iter(a._promises))
+        peer, _deadline = a._promises[mid]
+        a._promises[mid] = (peer, time.monotonic() - 1)
+        a.heartbeat()
+        assert a.peer_db.record(pid).score < 0
+    finally:
+        _close(nodes)
+
+
+def test_rate_limiter_quota():
+    rl = RateLimiter()
+    # status quota: 5 per 15s
+    assert all(rl.allow("p1", "status") for _ in range(5))
+    assert not rl.allow("p1", "status")
+    assert rl.allow("p2", "status")  # per-peer buckets
+
+
+def test_rpc_server_rate_limits_status_flood():
+    from lighthouse_tpu.client import Client, ClientConfig
+    from lighthouse_tpu.network import rpc
+
+    client = Client(
+        ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=8)
+    )
+
+    class Node:
+        chain = client.chain
+        metadata_seq = 1
+
+    db = PeerDB()
+    server = rpc.ReqRespServer(Node(), peer_db=db).start()
+    try:
+        ok = 0
+        for _ in range(8):
+            try:
+                chunks = rpc.request(server.addr, rpc.Protocol.PING, rpc.Ping(data=1))
+                if chunks:
+                    ok += 1
+            except (OSError, RuntimeError, ValueError):
+                pass
+        # ping quota is 2/10s: the flood is mostly rejected
+        assert ok <= 2
+        assert db.record("127.0.0.1").score < 0
+    finally:
+        server.stop()

@@ -147,9 +147,9 @@ def test_rpc_unknown_protocol_errors(node_with_chain):
 
 def test_gossip_floods_with_dedup_line_topology():
     got_b, got_c = [], []
-    a = GossipNode(deliver=lambda t, p: None)
-    b = GossipNode(deliver=lambda t, p: got_b.append((t, p)))
-    c = GossipNode(deliver=lambda t, p: got_c.append((t, p)))
+    a = GossipNode(deliver=lambda t, p, s: None)
+    b = GossipNode(deliver=lambda t, p, s: got_b.append((t, p)))
+    c = GossipNode(deliver=lambda t, p, s: got_c.append((t, p)))
     try:
         b.connect(a.addr)  # line: a - b - c (no a-c link)
         c.connect(b.addr)
@@ -199,9 +199,9 @@ def test_attestation_gossip_rides_subnet_topic_over_sockets():
         seen = []
         orig = net._deliver
 
-        def spy(service, topic_name, payload):
+        def spy(service, gossip, topic_name, payload, src):
             seen.append(topic_name)
-            return orig(service, topic_name, payload)
+            return orig(service, gossip, topic_name, payload, src)
 
         net._deliver = spy
         from lighthouse_tpu.state_transition.helpers import get_beacon_committee
