@@ -136,10 +136,14 @@ class BeaconChain:
         self.recompute_head()
         return block_root
 
-    def _post_import(self, block_root: bytes, signed_block, state) -> None:
+    def _post_import(
+        self, block_root: bytes, signed_block, state, execution_status: str | None = None
+    ) -> None:
         """Everything after a signature-valid transition: store, events,
         monitor, fork choice (the tail of beacon_chain.rs import_block).
-        Does NOT recompute the head — batch importers do that once."""
+        Does NOT recompute the head — batch importers do that once.
+        `execution_status` must be captured at transition time for batch
+        imports (the engine's last_status is per-call mutable state)."""
         t = self.ctx.types
         block = signed_block.message
         # the block carried a valid proposer signature: record (slot,
@@ -155,7 +159,9 @@ class BeaconChain:
 
         # fork choice: the block, then every attestation it carries
         self.fork_choice.on_tick(max(self.slot(), block.slot))
-        self.fork_choice.on_block(block, block_root, state)
+        if execution_status is None:
+            execution_status = self._execution_status_of(block)
+        self.fork_choice.on_block(block, block_root, state, execution_status=execution_status)
         for att in block.body.attestations:
             indexed = get_indexed_attestation(state, att, t, self.ctx.preset, self.ctx.spec)
             for vi in indexed.attesting_indices:
@@ -166,6 +172,25 @@ class BeaconChain:
                 self.fork_choice.on_attestation(indexed, is_from_block=True)
             except ForkChoiceError:
                 pass  # e.g. attestation for a block this store never saw
+
+    def _execution_status_of(self, block) -> str:
+        """EL verdict for the block just imported: "irrelevant" for payload-
+        less blocks, "valid" when the engine answered VALID during the
+        transition, "optimistic" for SYNCING/ACCEPTED or no engine
+        (PayloadVerificationStatus, beacon_chain.rs import path)."""
+        body = block.body
+        payload = getattr(body, "execution_payload", None)
+        if payload is None or payload == type(payload)():
+            return "irrelevant"
+        last = getattr(getattr(self.ctx, "execution_engine", None), "last_status", None)
+        return "valid" if last == "VALID" else "optimistic"
+
+    def on_invalid_execution_payload(self, block_root: bytes) -> None:
+        """The EL refuted a previously-optimistic payload: invalidate the
+        subtree and move the head off it (fork_choice.rs:516 +
+        payload_invalidation.rs)."""
+        self.fork_choice.on_invalid_execution_payload(bytes(block_root))
+        self.recompute_head()
 
     def process_chain_segment(self, blocks) -> list[bytes]:
         """Import a parent-linked ascending run of blocks with EVERY block's
@@ -215,16 +240,17 @@ class BeaconChain:
             root = type(block).hash_tree_root(block)
             if bytes(block.state_root) != type(state).hash_tree_root(state):
                 raise BlockError("segment block state root mismatch")
-            staged.append((root, signed, state.copy()))
+            # the engine verdict is per-block mutable state: capture it NOW
+            staged.append((root, signed, state.copy(), self._execution_status_of(block)))
             prev_root = root
 
         if all_sets and not self.ctx.bls.verify_signature_sets(all_sets):
             raise BlockError("segment signature verification failed")
 
-        for root, signed, post_state in staged:
-            self._post_import(root, signed, post_state)
+        for root, signed, post_state, exec_status in staged:
+            self._post_import(root, signed, post_state, execution_status=exec_status)
         self.recompute_head()
-        return [root for root, _, _ in staged]
+        return [root for root, _, _, _ in staged]
 
     def import_historical_block_batch(self, blocks) -> int:
         """Backfill: append blocks BEHIND the chain's oldest known block.

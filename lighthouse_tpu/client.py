@@ -126,7 +126,12 @@ class Client:
                 if state is None:
                     continue
                 self.chain.fork_choice.on_tick(blk.message.slot)
-                self.chain.fork_choice.on_block(blk.message, root, state)
+                # across a restart the EL has confirmed nothing: payload
+                # blocks replay as optimistic until re-verified
+                self.chain.fork_choice.on_block(
+                    blk.message, root, state,
+                    execution_status=self.chain._execution_status_of(blk.message),
+                )
         self.chain.recompute_head()
 
     # -- gossip ingestion via the work scheduler -------------------------------

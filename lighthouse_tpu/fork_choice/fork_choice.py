@@ -121,8 +121,10 @@ class ForkChoice:
 
     # -- on_block (fork_choice.rs:544) -----------------------------------------
 
-    def on_block(self, block, block_root: bytes, state) -> None:
-        """Register an imported block. `state` is the post-state of `block`."""
+    def on_block(self, block, block_root: bytes, state, execution_status: str = "irrelevant") -> None:
+        """Register an imported block. `state` is the post-state of `block`.
+        `execution_status` records the EL verdict for bellatrix blocks
+        ("valid" / "optimistic" / "irrelevant" for payload-less)."""
         if block.slot > self.current_slot:
             raise ForkChoiceError("block from the future")
         if not self.contains_block(bytes(block.parent_root)):
@@ -149,6 +151,22 @@ class ForkChoice:
             justified_epoch=state.current_justified_checkpoint.epoch,
             finalized_epoch=state.finalized_checkpoint.epoch,
         )
+        idx = self.proto.indices.get(block_root)
+        if idx is not None:
+            self.proto.nodes[idx].execution_status = execution_status
+            if execution_status == "valid":
+                # chained validity: confirm optimistic ancestors
+                self.proto.on_valid_execution_payload(block_root)
+
+    def on_invalid_execution_payload(self, block_root: bytes) -> None:
+        """fork_choice.rs:516 on_invalid_execution_payload: the EL refuted a
+        previously-optimistic payload — the block and its descendants leave
+        the head race."""
+        self.proto.on_invalid_execution_payload(block_root)
+
+    def is_optimistic(self, block_root: bytes) -> bool:
+        idx = self.proto.indices.get(bytes(block_root))
+        return idx is not None and self.proto.nodes[idx].execution_status == "optimistic"
 
     # -- on_attestation (fork_choice.rs:837) -----------------------------------
 

@@ -35,6 +35,10 @@ class ProtoNode:
     weight: int = 0
     best_child: int = NONE
     best_descendant: int = NONE
+    # execution-payload verdict (proto_array.rs ExecutionStatus):
+    #   "irrelevant" pre-merge, "valid" EL-confirmed, "optimistic" imported
+    #   while the EL was syncing, "invalid" EL-refuted (never head-viable)
+    execution_status: str = "irrelevant"
 
 
 @dataclass
@@ -144,10 +148,64 @@ class ProtoArray:
 
     def _node_is_viable_for_head(self, node: ProtoNode) -> bool:
         """proto_array.rs node_is_viable_for_head: filter_block_tree's
-        condition — the node must agree with the store's checkpoints."""
+        condition — the node must agree with the store's checkpoints, and an
+        EL-refuted payload disqualifies the block outright."""
+        if node.execution_status == "invalid":
+            return False
         return (
             node.justified_epoch == self.justified_epoch or self.justified_epoch == 0
         ) and (node.finalized_epoch == self.finalized_epoch or self.finalized_epoch == 0)
+
+    # -- execution-status propagation (proto_array.rs propagate_execution_*) ---
+
+    def on_invalid_execution_payload(self, root: bytes) -> None:
+        """Mark `root` and every descendant invalid (the INVALID response to
+        a previously-optimistic import), then recompute best children so
+        find_head routes around the poisoned subtree."""
+        start = self.indices.get(bytes(root))
+        if start is None:
+            raise ForkChoiceError("unknown block for payload invalidation")
+        invalid = {start}
+        for i, node in enumerate(self.nodes):
+            if node.parent in invalid:
+                invalid.add(i)
+        for i in invalid:
+            # status only — weights stay: the vote-delta machinery drains
+            # them naturally, and zeroing would break the delta invariant
+            # (apply_score_changes raises on negative weights)
+            self.nodes[i].execution_status = "invalid"
+        # rebuild best pointers leaf-to-root (same order apply_score_changes
+        # uses) so viability filtering applies everywhere
+        for node in self.nodes:
+            node.best_child = NONE
+            node.best_descendant = NONE
+        for i in range(len(self.nodes) - 1, -1, -1):
+            node = self.nodes[i]
+            if node.parent != NONE:
+                self._maybe_update_best_child_and_descendant(node.parent, i)
+
+    def on_valid_execution_payload(self, root: bytes) -> None:
+        """An EL VALID verdict confirms the block AND its ancestors
+        (payload validity is chained): the node itself flips from
+        optimistic, then optimistic ancestors flip until the first settled
+        (valid/irrelevant) one."""
+        i = self.indices.get(bytes(root))
+        if i is None:
+            raise ForkChoiceError("unknown block for payload validation")
+        node = self.nodes[i]
+        if node.execution_status == "invalid":
+            raise ForkChoiceError("VALID verdict contradicts earlier INVALID")
+        if node.execution_status == "optimistic":
+            node.execution_status = "valid"
+        i = node.parent
+        while i != NONE:
+            node = self.nodes[i]
+            if node.execution_status == "invalid":
+                raise ForkChoiceError("VALID verdict contradicts earlier INVALID")
+            if node.execution_status != "optimistic":
+                break  # settled: everything above is too
+            node.execution_status = "valid"
+            i = node.parent
 
     def _node_leads_to_viable_head(self, node: ProtoNode) -> bool:
         if node.best_descendant != NONE:

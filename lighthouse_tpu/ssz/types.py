@@ -456,6 +456,27 @@ class _ContainerMeta(type):
         if fields is not None:
             cls._field_names = [n for n, _ in fields]
             cls._field_types = [t for _, t in fields]
+            # Instance-level root caching is SOUND only when every field is
+            # an immutable leaf (uint/bool/byte-vector): then the only way
+            # to change the value is attribute assignment, which
+            # __setattr__ intercepts. Containers holding lists or nested
+            # containers can be mutated without touching this instance's
+            # attributes, so they stay uncached (cached_tree_hash's dirty
+            # tracking, restricted to where Python can see the dirt).
+            cls._leaf_cacheable = bool(fields) and all(
+                isinstance(t, (_UintN, _Boolean, _ByteVector))
+                for t in cls._field_types
+            )
+            if cls._leaf_cacheable and "__setattr__" not in ns:
+                # install the invalidating setattr ONLY on cacheable
+                # classes — everything else keeps object.__setattr__ (no
+                # per-assignment overhead on the hot non-cached containers)
+                def _invalidating_setattr(self, name, value, _set=object.__setattr__):
+                    _set(self, name, value)
+                    if name != "_root_cache":
+                        _set(self, "_root_cache", None)
+
+                cls.__setattr__ = _invalidating_setattr
         return cls
 
 
@@ -467,6 +488,8 @@ class Container(metaclass=_ContainerMeta):
     Container subclass can appear as a field/element type anywhere."""
 
     fields: list = []
+
+    _leaf_cacheable = False
 
     def __init__(self, **kwargs):
         for n, t in zip(self._field_names, self._field_types):
@@ -564,6 +587,12 @@ class Container(metaclass=_ContainerMeta):
 
     @classmethod
     def hash_tree_root(cls, v: "Container") -> bytes:
+        # fastest path: the instance's dirty-tracked cache (leaf-only
+        # containers; __setattr__ invalidates) — no serialization at all
+        if cls._leaf_cacheable:
+            got = getattr(v, "_root_cache", None)
+            if got is not None:
+                return got
         memo = None
         key = None
         if cls.root_memo_limit:
@@ -573,6 +602,8 @@ class Container(metaclass=_ContainerMeta):
             key = cls.serialize(v)
             got = memo.get(key)
             if got is not None:
+                if cls._leaf_cacheable:
+                    object.__setattr__(v, "_root_cache", got)
                 return got
         roots = [
             t.hash_tree_root(getattr(v, n))
@@ -583,6 +614,8 @@ class Container(metaclass=_ContainerMeta):
             if len(memo) >= cls.root_memo_limit:
                 memo.clear()  # simple epoch-style reset; refill is cheap
             memo[key] = root
+        if cls._leaf_cacheable:
+            object.__setattr__(v, "_root_cache", root)
         return root
 
     @classmethod

@@ -205,3 +205,93 @@ def test_post_merge_production_without_engine_raises(engine):
         chain.produce_block_on_state(
             chain.state_at_slot(2), 2, randao_reveal=b"\x06" * 96
         )
+
+
+def test_optimistic_import_and_payload_invalidation(engine):
+    """A SYNCING engine imports optimistically; a later INVALID verdict
+    routes fork choice off the poisoned subtree (fork_choice.rs:516 +
+    payload_invalidation.rs)."""
+    el = ExecutionLayer([EngineApiClient(engine.url, jwt_secret=SECRET)])
+    ctx = bellatrix_ctx(execution_engine=el)
+    from lighthouse_tpu.chain import BeaconChain
+
+    genesis = interop_genesis_state(8, 1_600_000_000, ctx)
+    chain = BeaconChain(genesis, ctx)
+
+    # block 1: VALID -> execution_status "valid"
+    chain.slot_clock.set_slot(1)
+    block, _ = chain.produce_block_on_state(chain.state_at_slot(1), 1, b"\x05" * 96)
+    sk, _ = ctx.bls.interop_keypair(int(block.proposer_index))
+    r1 = chain.process_block(chain.sign_block(block, sk))
+    assert not chain.fork_choice.is_optimistic(r1)
+
+    # block 2: the EL is syncing -> optimistic import
+    engine.next_status = "SYNCING"
+    chain.slot_clock.set_slot(2)
+    block2, _ = chain.produce_block_on_state(chain.state_at_slot(2), 2, b"\x06" * 96)
+    sk2, _ = ctx.bls.interop_keypair(int(block2.proposer_index))
+    r2 = chain.process_block(chain.sign_block(block2, sk2))
+    assert chain.fork_choice.is_optimistic(r2)
+    assert chain.head_root == r2
+
+    # the EL finishes syncing and refutes the payload: head reverts
+    chain.on_invalid_execution_payload(r2)
+    assert chain.head_root == r1, "head must leave the invalidated subtree"
+    idx = chain.fork_choice.proto.indices[r2]
+    assert chain.fork_choice.proto.nodes[idx].execution_status == "invalid"
+
+
+def test_chained_validity_confirms_optimistic_ancestors(engine):
+    """A VALID verdict on a descendant confirms optimistic ancestors
+    (payload validity is chained)."""
+    el = ExecutionLayer([EngineApiClient(engine.url, jwt_secret=SECRET)])
+    ctx = bellatrix_ctx(execution_engine=el)
+    from lighthouse_tpu.chain import BeaconChain
+
+    chain = BeaconChain(interop_genesis_state(8, 1_600_000_000, ctx), ctx)
+
+    engine.next_status = "SYNCING"
+    chain.slot_clock.set_slot(1)
+    b1, _ = chain.produce_block_on_state(chain.state_at_slot(1), 1, b"\x05" * 96)
+    sk1, _ = ctx.bls.interop_keypair(int(b1.proposer_index))
+    r1 = chain.process_block(chain.sign_block(b1, sk1))
+    assert chain.fork_choice.is_optimistic(r1)
+
+    engine.next_status = "VALID"
+    chain.slot_clock.set_slot(2)
+    b2, _ = chain.produce_block_on_state(chain.state_at_slot(2), 2, b"\x06" * 96)
+    sk2, _ = ctx.bls.interop_keypair(int(b2.proposer_index))
+    r2 = chain.process_block(chain.sign_block(b2, sk2))
+    assert not chain.fork_choice.is_optimistic(r2)
+    assert not chain.fork_choice.is_optimistic(r1), "ancestor confirmed by chained validity"
+
+
+def test_invalidation_survives_later_head_recompute(engine):
+    """After invalidation, importing more blocks and recomputing the head
+    must not crash on vote deltas (weights are drained, not zeroed)."""
+    el = ExecutionLayer([EngineApiClient(engine.url, jwt_secret=SECRET)])
+    ctx = bellatrix_ctx(execution_engine=el)
+    from lighthouse_tpu.chain import BeaconChain
+
+    chain = BeaconChain(interop_genesis_state(8, 1_600_000_000, ctx), ctx)
+    chain.slot_clock.set_slot(1)
+    b1, _ = chain.produce_block_on_state(chain.state_at_slot(1), 1, b"\x05" * 96)
+    sk1, _ = ctx.bls.interop_keypair(int(b1.proposer_index))
+    r1 = chain.process_block(chain.sign_block(b1, sk1))
+
+    engine.next_status = "SYNCING"
+    chain.slot_clock.set_slot(2)
+    b2, _ = chain.produce_block_on_state(chain.state_at_slot(2), 2, b"\x06" * 96)
+    sk2, _ = ctx.bls.interop_keypair(int(b2.proposer_index))
+    r2 = chain.process_block(chain.sign_block(b2, sk2))
+    chain.on_invalid_execution_payload(r2)
+    assert chain.head_root == r1
+
+    # keep building on the valid fork: head recomputes without error
+    engine.next_status = "VALID"
+    chain.slot_clock.set_slot(3)
+    state = chain.store.get_state(r1).copy()
+    b3, _ = chain.produce_block_on_state(state, 3, b"\x07" * 96)
+    sk3, _ = ctx.bls.interop_keypair(int(b3.proposer_index))
+    r3 = chain.process_block(chain.sign_block(b3, sk3))
+    assert chain.head_root == r3
