@@ -76,15 +76,27 @@ def _tiled_sets(b, n, keys_per_set=1, distinct=8):
 
 
 def bench_config2(b):
-    """#2: verify_signature_sets, 128 x 1-key sets (the headline metric)."""
+    """#2: verify_signature_sets, 128 x 1-key sets (the headline metric).
+
+    BENCH_MAX_BATCH splits the 128 sets into smaller dispatches — the CPU
+    fallback uses it to ride kernels already in the persistent cache (the
+    cold S=128 CPU compile runs ~1 h on this box; S<=16 shapes are cached
+    by the test suites)."""
     sets = _tiled_sets(b, N_SETS)
-    sec = _timed(lambda: b.verify_signature_sets(sets))
-    return {
+    max_batch = int(os.environ.get("BENCH_MAX_BATCH", N_SETS))
+    chunks = [sets[i : i + max_batch] for i in range(0, len(sets), max_batch)]
+    # evaluate EVERY chunk (no short-circuit: a failing chunk must not
+    # shrink the timed work and inflate the throughput number)
+    sec = _timed(lambda: all([b.verify_signature_sets(c) for c in chunks]))
+    out = {
         "metric": "verify_signature_sets_128x1_throughput",
         "value": round(N_SETS / sec, 2),
         "unit": "sets_per_sec",
         "vs_baseline": round(N_SETS / sec / BLST_CPU_BASELINE_SETS_PER_SEC, 4),
     }
+    if max_batch != N_SETS:
+        out["batch_shape"] = f"{len(chunks)}x{max_batch}"
+    return out
 
 
 def bench_config1(b):
@@ -316,13 +328,17 @@ def main() -> None:
     # JAX_PLATFORMS=cpu — with the vars unset the plugin stays idle
     # (same trick as tests/conftest.py).
     result, err = _run_child(
-        {"JAX_PLATFORMS": "cpu"},
+        {"JAX_PLATFORMS": "cpu", "BENCH_MAX_BATCH": os.environ.get("BENCH_MAX_BATCH", "8")},
         int(os.environ.get("BENCH_CPU_TIMEOUT", 2400)),
-        run_all,
+        (),  # fallback measures the headline config only
         drop_env=("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"),
     )
     if result is not None:
-        result["error"] = "; ".join(errors) + " — CPU-platform fallback measurement"
+        result["error"] = (
+            "; ".join(errors)
+            + " — CPU-platform fallback measurement (headline config only, "
+            "cached small-batch kernels)"
+        )
         print(json.dumps(result))
         return
     errors.append(f"cpu fallback: {err}")

@@ -178,9 +178,9 @@ class BeaconChain:
         less blocks, "valid" when the engine answered VALID during the
         transition, "optimistic" for SYNCING/ACCEPTED or no engine
         (PayloadVerificationStatus, beacon_chain.rs import path)."""
-        body = block.body
-        payload = getattr(body, "execution_payload", None)
-        if payload is None or payload == type(payload)():
+        from ..state_transition.bellatrix import block_has_payload
+
+        if not block_has_payload(block):
             return "irrelevant"
         last = getattr(getattr(self.ctx, "execution_engine", None), "last_status", None)
         return "valid" if last == "VALID" else "optimistic"

@@ -152,6 +152,63 @@ def cmd_lcli(args) -> int:
             value = td.deserialize(f.read())
         print(json.dumps(encode(value, td), indent=2))
         return 0
+    if args.lcli_cmd == "transition-blocks":
+        # lcli/src/transition_blocks.rs: pre-state + block -> post-state
+        from .state_transition import BlockSignatureStrategy, state_transition
+        from .types import decode_beacon_state, decode_signed_block
+
+        with open(args.pre, "rb") as f:
+            state = decode_beacon_state(f.read(), ctx.types, ctx.spec)
+        with open(args.block, "rb") as f:
+            signed = decode_signed_block(f.read(), ctx.types, ctx.spec, ctx.preset)
+        strategy = (
+            BlockSignatureStrategy.NO_VERIFICATION
+            if args.no_signature_verification
+            else BlockSignatureStrategy.VERIFY_BULK
+        )
+        state_transition(state, signed, ctx, strategy=strategy)
+        with open(args.output, "wb") as f:
+            f.write(type(state).serialize(state))
+        root = type(state).hash_tree_root(state)
+        print(f"post-state slot {int(state.slot)} -> {args.output}; root 0x{root.hex()}")
+        return 0
+    if args.lcli_cmd == "hash-tree-root":
+        # lcli parse_ssz's root mode: root of any named SSZ type
+        td = getattr(ctx.types, args.type)
+        with open(args.file, "rb") as f:
+            value = td.deserialize(f.read())
+        print("0x" + td.hash_tree_root(value).hex())
+        return 0
+    if args.lcli_cmd == "change-genesis-time":
+        from .types import decode_beacon_state
+
+        with open(args.state, "rb") as f:
+            state = decode_beacon_state(f.read(), ctx.types, ctx.spec)
+        state.genesis_time = args.genesis_time
+        with open(args.state, "wb") as f:
+            f.write(type(state).serialize(state))
+        print(f"genesis time -> {args.genesis_time}")
+        return 0
+    if args.lcli_cmd == "check-deposit-data":
+        # lcli/src/check_deposit_data.rs: decode + verify the deposit sig
+        from .state_transition import signature_sets as sigsets
+        from .types.containers import DepositData
+
+        with open(args.file, "rb") as f:
+            dd = DepositData.deserialize(f.read())
+        s = sigsets.deposit_signature_set(dd, ctx.bls, ctx.spec)
+        ok = ctx.bls.verify_signature_sets([s])
+        print(f"pubkey 0x{bytes(dd.pubkey).hex()} amount {int(dd.amount)} "
+              f"signature {'VALID' if ok else 'INVALID'}")
+        return 0 if ok else 1
+    if args.lcli_cmd == "generate-bootnode-enr":
+        from .network.enr import Enr, generate_key
+
+        enr = Enr.build(generate_key(), ip=args.ip, udp=args.port).to_text()
+        with open(args.output, "w") as f:
+            f.write(enr)
+        print(enr)
+        return 0
     raise SystemExit(f"unknown lcli command {args.lcli_cmd}")
 
 
@@ -245,6 +302,23 @@ def build_parser() -> argparse.ArgumentParser:
     sk.add_argument("--state", required=True)
     sk.add_argument("--slots", type=int, required=True)
     sk.add_argument("--output", required=True)
+    tb = lc_sub.add_parser("transition-blocks")
+    tb.add_argument("--pre", required=True)
+    tb.add_argument("--block", required=True)
+    tb.add_argument("--output", required=True)
+    tb.add_argument("--no-signature-verification", action="store_true")
+    hr = lc_sub.add_parser("hash-tree-root")
+    hr.add_argument("--type", required=True)
+    hr.add_argument("--file", required=True)
+    cg = lc_sub.add_parser("change-genesis-time")
+    cg.add_argument("--state", required=True)
+    cg.add_argument("--genesis-time", type=int, required=True)
+    cd = lc_sub.add_parser("check-deposit-data")
+    cd.add_argument("--file", required=True)
+    ge = lc_sub.add_parser("generate-bootnode-enr")
+    ge.add_argument("--ip", default="127.0.0.1")
+    ge.add_argument("--port", type=int, default=9000)
+    ge.add_argument("--output", required=True)
     ps = lc_sub.add_parser("pretty-ssz")
     ps.add_argument("--type", required=True)
     ps.add_argument("--file", required=True)

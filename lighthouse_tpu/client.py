@@ -127,10 +127,15 @@ class Client:
                     continue
                 self.chain.fork_choice.on_tick(blk.message.slot)
                 # across a restart the EL has confirmed nothing: payload
-                # blocks replay as optimistic until re-verified
+                # blocks replay as OPTIMISTIC until re-verified (never
+                # consult the engine's stale last_status here)
+                from .state_transition.bellatrix import block_has_payload
+
                 self.chain.fork_choice.on_block(
                     blk.message, root, state,
-                    execution_status=self.chain._execution_status_of(blk.message),
+                    execution_status=(
+                        "optimistic" if block_has_payload(blk.message) else "irrelevant"
+                    ),
                 )
         self.chain.recompute_head()
 

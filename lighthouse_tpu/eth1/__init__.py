@@ -7,12 +7,15 @@ reference tests against ganache). `Eth1Service.eth1_data_for_block`
 computes the eth1 vote (the follow-distance block + deposit snapshot).
 """
 
+from .json_rpc import JsonRpcEth1Endpoint, MockEth1RpcServer
 from .service import DepositCache, Eth1Block, Eth1Service, MockEth1Endpoint, make_deposit
 
 __all__ = [
     "DepositCache",
     "Eth1Block",
     "Eth1Service",
+    "JsonRpcEth1Endpoint",
     "MockEth1Endpoint",
+    "MockEth1RpcServer",
     "make_deposit",
 ]

@@ -144,6 +144,7 @@ class ForkChoice:
         if block.slot == self.current_slot and self.proposer_boost_root == ZERO_ROOT:
             self.proposer_boost_root = block_root
 
+        known = block_root in self.proto.indices
         self.proto.on_block(
             slot=block.slot,
             root=block_root,
@@ -153,9 +154,12 @@ class ForkChoice:
         )
         idx = self.proto.indices.get(block_root)
         if idx is not None:
-            self.proto.nodes[idx].execution_status = execution_status
-            if execution_status == "valid":
-                # chained validity: confirm optimistic ancestors
+            if not known:
+                self.proto.nodes[idx].execution_status = execution_status
+            # a VALID verdict upgrades (and chain-confirms ancestors); a
+            # re-import must never DOWNGRADE a settled verdict — in
+            # particular not resurrect an EL-refuted block
+            if execution_status == "valid" and self.proto.nodes[idx].execution_status != "invalid":
                 self.proto.on_valid_execution_payload(block_root)
 
     def on_invalid_execution_payload(self, block_root: bytes) -> None:

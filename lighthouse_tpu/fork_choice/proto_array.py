@@ -169,6 +169,11 @@ class ProtoArray:
         for i, node in enumerate(self.nodes):
             if node.parent in invalid:
                 invalid.add(i)
+        if any(self.nodes[i].execution_status == "valid" for i in invalid):
+            # the reference aborts here too
+            # (ValidExecutionStatusBecameInvalid): a confirmed payload
+            # cannot become invalid without a consensus failure
+            raise ForkChoiceError("INVALID verdict contradicts earlier VALID")
         for i in invalid:
             # status only — weights stay: the vote-delta machinery drains
             # them naturally, and zeroing would break the delta invariant

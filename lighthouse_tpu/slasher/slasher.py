@@ -30,7 +30,7 @@ class _ValidatorHistory:
 
 
 class Slasher:
-    def __init__(self, ctx, config: SlasherConfig | None = None):
+    def __init__(self, ctx, config: SlasherConfig | None = None, db_path: str | None = None):
         self.ctx = ctx
         self.config = config or SlasherConfig()
         self.queue: list = []
@@ -40,6 +40,18 @@ class Slasher:
         self.history: dict[int, _ValidatorHistory] = {}
         # (proposer, slot) -> signed header
         self.proposals: dict[tuple[int, int], object] = {}
+        # optional durable store (slasher/src/database.rs role)
+        self.db = None
+        if db_path is not None:
+            from .db import SlasherDB
+
+            self.db = SlasherDB(db_path)
+            self.attestation_by_target, rows, self.proposals = self.db.load(ctx.types)
+            for v, src, tgt, att in rows:
+                hist = self.history.setdefault(v, _ValidatorHistory())
+                hist.sources.append(src)
+                hist.targets.append(tgt)
+                hist.records.append(att)
 
     # -- ingestion (slasher.rs:69-77) -----------------------------------------
 
@@ -90,6 +102,11 @@ class Slasher:
                 hist.sources.append(src)
                 hist.targets.append(tgt)
                 hist.records.append(att)
+                if self.db is not None:
+                    self.db.put_attestation(
+                        int(v), int(tgt), int(src), bytes(data_root),
+                        type(att).serialize(att),
+                    )
         self.queue.clear()
 
         for signed in self.block_queue:
@@ -104,9 +121,13 @@ class Slasher:
                 )
             else:
                 self.proposals[key] = signed
+                if self.db is not None:
+                    self.db.put_proposal(key[0], key[1], type(signed).serialize(signed))
         self.block_queue.clear()
 
         self._prune(current_epoch)
+        if self.db is not None:
+            self.db.commit()
         return attester_slashings, proposer_slashings
 
     # -- pruning (migrate.rs) --------------------------------------------------
@@ -115,6 +136,9 @@ class Slasher:
         cutoff = current_epoch - self.config.history_length
         if cutoff <= 0:
             return
+        if self.db is not None:
+            spe = self.ctx.preset.slots_per_epoch
+            self.db.prune(cutoff, cutoff * spe)
         self.attestation_by_target = {
             k: v for k, v in self.attestation_by_target.items() if k[1] >= cutoff
         }

@@ -100,6 +100,15 @@ def process_execution_payload(state, payload, ctx: TransitionContext) -> None:
     )
 
 
+def block_has_payload(block) -> bool:
+    """True when the block body carries a real (non-default) execution
+    payload — a real payload always commits to a nonzero EL block hash
+    (is_merge_transition_block's emptiness test, shared so importers and
+    fork choice agree on one definition)."""
+    payload = getattr(block.body, "execution_payload", None)
+    return payload is not None and bytes(payload.block_hash) != b"\x00" * 32
+
+
 def upgrade_to_bellatrix(state, ctx: TransitionContext):
     """upgrade/merge.rs upgrade_to_bellatrix: in-place class swap (see
     altair.upgrade_to_altair) + a zeroed execution payload header."""

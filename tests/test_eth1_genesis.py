@@ -88,3 +88,52 @@ def test_genesis_trigger_rules(ctx, deposits):
     assert is_valid_genesis_state(state, ctx)
     state.genesis_time = 0
     assert not is_valid_genesis_state(state, ctx)
+
+
+def test_eth1_service_over_json_rpc():
+    """The Eth1Service follows a real HTTP JSON-RPC endpoint: DepositEvent
+    logs ABI-decode into the cache and the eth1 vote matches the in-memory
+    run (http.rs + deposit_log.rs; endpoint fallback with a dead primary)."""
+    from lighthouse_tpu.crypto import bls as bls_pkg
+    from lighthouse_tpu.eth1 import (
+        Eth1Service,
+        JsonRpcEth1Endpoint,
+        MockEth1Endpoint,
+        MockEth1RpcServer,
+        make_deposit,
+    )
+    from lighthouse_tpu.eth1.json_rpc import decode_deposit_log, encode_deposit_log
+    from lighthouse_tpu.types import MINIMAL_SPEC
+
+    bls = bls_pkg.backend("fake")
+    backend = MockEth1Endpoint()
+    server = MockEth1RpcServer(backend).start()
+    try:
+        for i in range(3):
+            sk, _ = bls.interop_keypair(i)
+            dd = make_deposit(bls, sk, 32 * 10**9, MINIMAL_SPEC)
+            backend.submit_deposit(dd)
+            backend.mine_block()
+        for _ in range(5):
+            backend.mine_block()  # clear the follow distance
+
+        # codec round-trip
+        sk, _ = bls.interop_keypair(0)
+        dd0 = make_deposit(bls, sk, 32 * 10**9, MINIMAL_SPEC)
+        rt, idx = decode_deposit_log(encode_deposit_log(dd0, 7))
+        assert rt == dd0 and idx == 7
+
+        client = JsonRpcEth1Endpoint(["http://127.0.0.1:1", server.url], timeout=2)
+        svc = Eth1Service(client, follow_distance=4)
+        svc.update()
+        assert len(svc.deposit_cache) == 3
+        vote = svc.eth1_data_for_block()
+
+        ref_svc = Eth1Service(backend, follow_distance=4)
+        ref_svc.update()
+        ref_vote = ref_svc.eth1_data_for_block()
+        assert bytes(vote.deposit_root) == bytes(ref_vote.deposit_root)
+        assert vote.deposit_count == ref_vote.deposit_count
+        assert bytes(vote.block_hash) == bytes(ref_vote.block_hash)
+    finally:
+        server.stop()

@@ -1,0 +1,57 @@
+"""lcli dev tools (lcli/src/main.rs:54-603 subset)."""
+
+from lighthouse_tpu.cli import main
+
+
+def test_lcli_transition_blocks_and_roots(tmp_path):
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.state_transition import TransitionContext
+
+    ctx = TransitionContext.minimal("fake")
+    h = BeaconChainHarness(8, ctx)
+    pre = h.chain.head_state().copy()
+    h.extend_chain(1)
+    blk = h.chain.store.get_block(h.chain.head_root)
+    post = h.chain.head_state()
+
+    pre_p = tmp_path / "pre.ssz"
+    blk_p = tmp_path / "blk.ssz"
+    out_p = tmp_path / "post.ssz"
+    pre_p.write_bytes(type(pre).serialize(pre))
+    blk_p.write_bytes(type(blk).serialize(blk))
+
+    rc = main(
+        [
+            "lcli", "--preset", "minimal", "--bls-backend", "fake",
+            "transition-blocks", "--pre", str(pre_p), "--block", str(blk_p),
+            "--output", str(out_p), "--no-signature-verification",
+        ]
+    )
+    assert rc == 0
+    assert out_p.read_bytes() == type(post).serialize(post)
+
+    rc = main(
+        [
+            "lcli", "--preset", "minimal", "--bls-backend", "fake",
+            "hash-tree-root", "--type", "BeaconState", "--file", str(out_p),
+        ]
+    )
+    assert rc == 0
+
+
+def test_lcli_check_deposit_data(tmp_path):
+    from lighthouse_tpu.crypto import bls as bls_pkg
+    from lighthouse_tpu.eth1 import make_deposit
+    from lighthouse_tpu.types import MINIMAL_SPEC
+    from lighthouse_tpu.types.containers import DepositData
+
+    bls = bls_pkg.backend("fake")
+    sk, _ = bls.interop_keypair(0)
+    dd = make_deposit(bls, sk, 32 * 10**9, MINIMAL_SPEC)
+    p = tmp_path / "dd.ssz"
+    p.write_bytes(DepositData.serialize(dd))
+    rc = main(
+        ["lcli", "--preset", "minimal", "--bls-backend", "fake",
+         "check-deposit-data", "--file", str(p)]
+    )
+    assert rc == 0

@@ -103,3 +103,39 @@ def test_history_pruning(ctx):
     assert s.history
     s.process_queued(current_epoch=10)  # far future: everything pruned
     assert not s.history and not s.attestation_by_target
+
+
+def test_slasher_persists_across_restart(tmp_path):
+    """A double vote whose halves arrive in different PROCESS LIFETIMES is
+    still caught: history is durable (slasher/src/database.rs role)."""
+    from lighthouse_tpu.slasher import Slasher
+    from lighthouse_tpu.state_transition import TransitionContext
+
+    ctx = TransitionContext.minimal("fake")
+    t = ctx.types
+    db = str(tmp_path / "slasher.sqlite")
+
+    def att(root_byte, target):
+        return t.IndexedAttestation(
+            attesting_indices=[3],
+            data=t.AttestationData(
+                slot=target * 8, index=0,
+                beacon_block_root=bytes([root_byte]) * 32,
+                source=t.Checkpoint(epoch=target - 1, root=b"\x00" * 32),
+                target=t.Checkpoint(epoch=target, root=bytes([root_byte]) * 32),
+            ),
+            signature=b"\x00" * 96,
+        )
+
+    s1 = Slasher(ctx, db_path=db)
+    s1.accept_attestation(att(0x0A, 5))
+    a, p = s1.process_queued(current_epoch=5)
+    assert not a and not p
+    s1.db.close()
+    del s1
+
+    s2 = Slasher(ctx, db_path=db)  # "restart"
+    assert (3, 5) in s2.attestation_by_target
+    s2.accept_attestation(att(0x0B, 5))  # same target, different data
+    a, p = s2.process_queued(current_epoch=5)
+    assert len(a) == 1, "double vote across restart detected"
