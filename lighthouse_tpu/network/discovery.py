@@ -81,13 +81,24 @@ class DiscoveryService:
     """One node's discovery endpoint. `boot_mode=True` is the boot_node
     profile: answer queries, never query out."""
 
-    def __init__(self, key, host: str = "127.0.0.1", port: int = 0, boot_mode: bool = False):
+    def __init__(
+        self,
+        key,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        boot_mode: bool = False,
+        tcp_port: int | None = None,
+    ):
         self.key = key
         self.boot_mode = boot_mode
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
         self._sock.bind((host, port))
         self.addr = self._sock.getsockname()
-        self.enr = Enr.build(key, seq=1, ip=self.addr[0], udp=self.addr[1])
+        # tcp = the node's gossip/rpc listener: discovered peers dial it
+        # (the ENR tcp field lighthouse_network reads for libp2p dialing)
+        self.enr = Enr.build(
+            key, seq=1, ip=self.addr[0], udp=self.addr[1], tcp=tcp_port
+        )
         self.table = RoutingTable(self.enr.node_id())
         self._pending: dict[bytes, threading.Event] = {}
         self._responses: dict[bytes, list] = {}

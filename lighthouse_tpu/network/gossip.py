@@ -110,6 +110,8 @@ class GossipNode:
         # publish racing a forward) must not interleave frame bytes
         self._peers: dict[socket.socket, threading.Lock] = {}
         self._peer_ids: dict[socket.socket, str] = {}
+        self._dialed: set[tuple] = set()  # outbound addrs (dial dedup)
+        self._sock_dial_addr: dict[socket.socket, tuple] = {}
         self._peers_lock = threading.Lock()
         self._mesh: dict[str, set[socket.socket]] = {}
         self._seen: OrderedDict[bytes, None] = OrderedDict()
@@ -131,13 +133,28 @@ class GossipNode:
 
     # -- peering ---------------------------------------------------------------
 
-    def connect(self, addr) -> None:
-        sock = socket.create_connection(addr, timeout=10)
+    def connect(self, addr, timeout: float = 10.0) -> bool:
+        """Dial a peer's listener; returns False when the address is
+        already dialed (idempotent — periodic discovery sweeps must not
+        stack duplicate links)."""
+        addr = tuple(addr)
+        with self._peers_lock:
+            if addr in self._dialed:
+                return False
+            self._dialed.add(addr)
+        try:
+            sock = socket.create_connection(addr, timeout=timeout)
+        except OSError:
+            with self._peers_lock:
+                self._dialed.discard(addr)  # retryable later
+            raise
         # the connect timeout must not survive onto the long-lived link: a
         # blocking recv() on an idle mesh would raise after 10 s and the
         # recv loop would reap a healthy peer
         sock.settimeout(None)
+        self._sock_dial_addr[sock] = addr
         self._add_peer(sock)
+        return True
 
     def _peer_id(self, sock: socket.socket) -> str:
         """Logical peer id: the HELLO-announced node id once received;
@@ -168,6 +185,9 @@ class GossipNode:
     def _drop_peer(self, sock: socket.socket) -> None:
         with self._peers_lock:
             self._peers.pop(sock, None)
+            dialed = self._sock_dial_addr.pop(sock, None)
+            if dialed is not None:
+                self._dialed.discard(dialed)  # allow a future redial
             for mesh in self._mesh.values():
                 mesh.discard(sock)
         self.peer_db.on_disconnect(self._peer_id(sock))

@@ -90,6 +90,22 @@ class SocketNetwork:
         with self._lock:
             return [nid for nid in self._nodes if nid != requester_id]
 
+    def gossip_addr(self, node_id: str):
+        """This node's gossip TCP listener (for its ENR tcp field)."""
+        with self._lock:
+            return self._nodes[node_id]["gossip"].addr
+
+    def connect_peer(self, node_id: str, addr, timeout: float = 2.0) -> None:
+        """Dial a discovered peer's gossip listener (discovery -> gossip
+        peer selection; the libp2p dial lighthouse_network issues from
+        discv5 results). Idempotent per address; short timeout so stale
+        table entries cannot stall the sweep."""
+        with self._lock:
+            entry = self._nodes.get(node_id)
+        if entry is None:
+            raise OSError(f"node {node_id} is not registered on this network")
+        return entry["gossip"].connect(tuple(addr), timeout=timeout)
+
     def blocks_by_range_from(
         self, requester_id: str, peer_id: str, start_slot: int, count: int
     ):
