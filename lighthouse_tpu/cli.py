@@ -60,14 +60,34 @@ def cmd_beacon_node(args) -> int:
         print(f"ran {args.run_slots} slots; head slot {client.chain.head_state().slot}")
         client.shutdown()
         return 0
-    try:
+    # long-running profile: the slot timer runs as a supervised critical
+    # task; its failure (or Ctrl-C) requests a client-wide shutdown with a
+    # reason (common/task_executor.rs:281 spawn + shutdown-sender flow)
+    from .common.task_executor import TaskExecutor
+
+    executor = TaskExecutor(name="beacon-node")
+
+    def slot_timer():
         spe = client.ctx.spec.seconds_per_slot
-        while True:
-            time.sleep(spe)
+        while not executor.exit.wait(spe):
             slot = client.chain.slot() + 1
             client.per_slot_task(slot)
+
+    executor.spawn(slot_timer, "slot-timer", critical=True)
+    try:
+        reason = executor.wait_shutdown()
     except KeyboardInterrupt:
-        client.shutdown()
+        executor.shutdown("SIGINT")
+        reason = executor.shutdown_reason
+    print(f"shutting down: {reason}")
+    # the store must not be persisted/migrated while a task still runs:
+    # wait (generously) for stragglers before touching the DB
+    stragglers = executor.join_all(timeout=30.0)
+    if stragglers:
+        print(f"WARNING: tasks still running: {[t.name for t in stragglers]}; "
+              "skipping head persistence to avoid a torn write")
+        return 1
+    client.shutdown()
     return 0
 
 

@@ -181,3 +181,7 @@ PROCESSOR_ITEMS_DROPPED = REGISTRY.counter(
     "lighthouse_tpu_processor_items_dropped_total",
     "Work items dropped because their handler raised (hostile-input isolation)",
 )
+TASKS_FAILED_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_tasks_failed_total",
+    "Supervised tasks that died with an uncaught exception",
+)
