@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import pathlib
 import sys
 import time
 
@@ -35,8 +36,24 @@ def _parse_jwt_secret(hex_str: str | None) -> bytes | None:
 def cmd_beacon_node(args) -> int:
     from .client import Client, ClientConfig
 
+    spec_override = None
+    if args.testnet_dir:
+        from .networks import load_config_yaml, network_config
+        from .types import MAINNET_SPEC, MINIMAL_SPEC
+
+        if args.network is not None:
+            # base the override on the NETWORK's spec, not --preset's
+            # default (mixing the two yields a mismatched pair)
+            _, base = network_config(args.network)
+        else:
+            base = MINIMAL_SPEC if args.preset == "minimal" else MAINNET_SPEC
+        spec_override = load_config_yaml(
+            pathlib.Path(args.testnet_dir) / "config.yaml", base=base
+        )
     cfg = ClientConfig(
         preset=args.preset,
+        network=args.network,
+        spec_override=spec_override,
         bls_backend=args.bls_backend,
         datadir=args.datadir,
         http_port=args.http_port,
@@ -272,6 +289,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     bn = sub.add_parser("beacon-node", help="run a beacon node")
     _add_common(bn)
+    bn.add_argument("--network", help="named network config (mainnet/minimal/interop-merge)")
+    bn.add_argument("--testnet-dir", help="directory with a config.yaml spec override")
     bn.add_argument("--datadir")
     bn.add_argument("--http-port", type=int, default=5052)
     bn.add_argument("--slasher", action="store_true")

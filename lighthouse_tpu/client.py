@@ -44,6 +44,11 @@ class ClientConfig:
     # execution layer (bellatrix): engine endpoints + shared JWT secret
     execution_endpoints: list = field(default_factory=list)
     jwt_secret: bytes | None = None
+    # network selection (eth2_network_config): a named network or a custom
+    # ChainSpec (e.g. loaded from a testnet dir's config.yaml); either
+    # overrides `preset`'s default spec
+    network: str | None = None
+    spec_override: object = None
 
 
 class Client:
@@ -51,11 +56,20 @@ class Client:
 
     def __init__(self, config: ClientConfig):
         self.config = config
+        preset_name, spec = config.preset, None
+        if config.network is not None:
+            from .networks import network_config
+
+            preset_name, spec = network_config(config.network)
+        if config.spec_override is not None:
+            spec = config.spec_override
         ctx = (
             TransitionContext.minimal(config.bls_backend)
-            if config.preset == "minimal"
+            if preset_name == "minimal"
             else TransitionContext.mainnet(config.bls_backend)
         )
+        if spec is not None:
+            ctx.spec = spec
         self.ctx = ctx
 
         if config.execution_endpoints:

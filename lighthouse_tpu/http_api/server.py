@@ -147,10 +147,34 @@ class _Handler(BaseHTTPRequestHandler):
                         "head_slot": str(chain.head_state().slot),
                         "sync_distance": "0",
                         "is_syncing": False,
-                        "is_optimistic": False,
+                        "is_optimistic": bool(
+                            chain.fork_choice.is_optimistic(chain.head_root)
+                        ),
                     }
                 ),
             )
+        elif parts == ["eth", "v1", "node", "identity"]:
+            # the subset of the identity payload this stack models (no
+            # libp2p peer id; the gossip node id is the logical identity)
+            self._send(
+                200,
+                _data(
+                    {
+                        "peer_id": getattr(self.api, "node_id", "lighthouse-tpu"),
+                        "enr": "",
+                        "p2p_addresses": [],
+                        "discovery_addresses": [],
+                        "metadata": {"seq_number": "1", "attnets": "0x00"},
+                    }
+                ),
+            )
+        elif parts == ["eth", "v1", "config", "spec"]:
+            from ..networks import dump_config_dict
+
+            pairs = dump_config_dict(ctx.spec)
+            pairs["SLOTS_PER_EPOCH"] = str(ctx.preset.slots_per_epoch)
+            pairs["PRESET_BASE"] = ctx.preset.name
+            self._send(200, _data(pairs))
         elif parts == ["eth", "v1", "beacon", "genesis"]:
             st = chain.store.get_state(chain.genesis_block_root)
             self._send(
@@ -183,6 +207,54 @@ class _Handler(BaseHTTPRequestHandler):
                     200,
                     _data({"root": "0x" + type(state).hash_tree_root(state).hex()}),
                 )
+            elif parts[5] == "validators":
+                # /eth/v1/beacon/states/{id}/validators (optional ?id= filter)
+                from ..types import FAR_FUTURE_EPOCH
+
+                wanted = None
+                if "id" in q:
+                    index_by_pk = {
+                        bytes(v.pubkey): i for i, v in enumerate(state.validators)
+                    }
+                    wanted = set()
+                    for item in q["id"]:
+                        for tok in item.split(","):
+                            tok = tok.strip()
+                            if not tok:
+                                continue
+                            if tok.startswith("0x"):  # pubkey id (spec-legal)
+                                idx = index_by_pk.get(bytes.fromhex(tok[2:]))
+                                if idx is not None:
+                                    wanted.add(idx)
+                            elif tok.isdigit():
+                                wanted.add(int(tok))
+                            else:
+                                raise ApiError(400, f"bad validator id {tok!r}")
+                out = []
+                epoch = compute_epoch_at_slot(int(state.slot), ctx.preset)
+                for i, v in enumerate(state.validators):
+                    if wanted is not None and i not in wanted:
+                        continue
+                    if v.activation_epoch > epoch:
+                        status = "pending_queued"
+                    elif epoch < v.exit_epoch:
+                        if v.slashed:
+                            status = "active_slashed"
+                        elif int(v.exit_epoch) != FAR_FUTURE_EPOCH:
+                            status = "active_exiting"
+                        else:
+                            status = "active_ongoing"
+                    else:
+                        status = "exited_slashed" if v.slashed else "exited_unslashed"
+                    out.append(
+                        {
+                            "index": str(i),
+                            "balance": str(int(state.balances[i])),
+                            "status": status,
+                            "validator": encode(v, type(v)),
+                        }
+                    )
+                self._send(200, _data(out))
             elif parts[5] == "sync_committees":
                 if ctx.types.fork_of(state) == "phase0":
                     raise ApiError(400, "state is pre-altair")

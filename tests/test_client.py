@@ -110,3 +110,31 @@ def test_checkpoint_sync_boot(tmp_path):
         assert b.chain.head_state().slot == a.chain.head_state().slot
     finally:
         a.shutdown()
+
+
+def test_named_network_and_testnet_dir(tmp_path):
+    """--network and config.yaml overrides reach the client's ChainSpec
+    (eth2_network_config's role)."""
+    from lighthouse_tpu.client import Client, ClientConfig
+    from lighthouse_tpu.networks import dump_config_yaml
+    from lighthouse_tpu.types import MINIMAL_SPEC
+
+    c = Client(
+        ClientConfig(network="interop-merge", bls_backend="fake", http_enabled=False,
+                     interop_validators=8)
+    )
+    assert c.ctx.spec.bellatrix_fork_epoch == 0
+    assert c.ctx.types.fork_of(c.chain.head_state()) == "bellatrix"
+
+    import dataclasses
+
+    custom = dataclasses.replace(MINIMAL_SPEC, altair_fork_epoch=3)
+    (tmp_path / "config.yaml").write_text(dump_config_yaml(custom))
+    from lighthouse_tpu.networks import load_config_yaml
+
+    spec = load_config_yaml(tmp_path / "config.yaml", base=MINIMAL_SPEC)
+    c2 = Client(
+        ClientConfig(preset="minimal", spec_override=spec, bls_backend="fake",
+                     http_enabled=False, interop_validators=8)
+    )
+    assert c2.ctx.spec.altair_fork_epoch == 3

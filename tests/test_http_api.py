@@ -195,3 +195,23 @@ def test_validator_monitor_counts(server):
     att_after = sum(chain.validator_monitor.summary(i)["attestations"] for i in range(16))
     assert after == before + 4  # one proposal per driven slot, all monitored
     assert att_after > att_before  # packed attestations were attributed
+
+
+def test_config_spec_identity_and_validators(server):
+    ctx, chain, srv = server
+    status, resp = _get(srv, "/eth/v1/config/spec")
+    assert status == 200
+    assert resp["data"]["SECONDS_PER_SLOT"] == str(ctx.spec.seconds_per_slot)
+    assert resp["data"]["PRESET_BASE"] == "minimal"
+    assert resp["data"]["GENESIS_FORK_VERSION"].startswith("0x")
+
+    status, resp = _get(srv, "/eth/v1/node/identity")
+    assert status == 200 and "metadata" in resp["data"]
+
+    status, resp = _get(srv, "/eth/v1/beacon/states/head/validators")
+    assert status == 200
+    rows = resp["data"]
+    assert len(rows) == len(chain.head_state().validators)
+    assert rows[0]["status"] == "active_ongoing"
+    status, resp = _get(srv, "/eth/v1/beacon/states/head/validators?id=1,3")
+    assert [r["index"] for r in resp["data"]] == ["1", "3"]
