@@ -289,7 +289,13 @@ def build_parser() -> argparse.ArgumentParser:
 
     bn = sub.add_parser("beacon-node", help="run a beacon node")
     _add_common(bn)
-    bn.add_argument("--network", help="named network config (mainnet/minimal/interop-merge)")
+    from .networks import NETWORKS
+
+    bn.add_argument(
+        "--network",
+        choices=sorted(NETWORKS),
+        help="named network config",
+    )
     bn.add_argument("--testnet-dir", help="directory with a config.yaml spec override")
     bn.add_argument("--datadir")
     bn.add_argument("--http-port", type=int, default=5052)

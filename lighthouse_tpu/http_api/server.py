@@ -168,6 +168,59 @@ class _Handler(BaseHTTPRequestHandler):
                     }
                 ),
             )
+        elif len(parts) == 5 and parts[:4] == ["eth", "v1", "beacon", "pool"]:
+            pool = api.op_pool
+            kind = parts[4]
+            if kind == "attestations":
+                atts = [a for bucket in pool.attestations.values() for a in bucket]
+                self._send(200, _data([encode(a, type(a)) for a in atts]))
+            elif kind == "voluntary_exits":
+                self._send(
+                    200,
+                    _data([encode(e, type(e)) for e in pool.voluntary_exits.values()]),
+                )
+            elif kind == "proposer_slashings":
+                self._send(
+                    200,
+                    _data([encode(s, type(s)) for s in pool.proposer_slashings.values()]),
+                )
+            elif kind == "attester_slashings":
+                self._send(
+                    200, _data([encode(s, type(s)) for s in pool.attester_slashings])
+                )
+            else:
+                raise ApiError(404, "unknown pool resource")
+        elif parts == ["eth", "v1", "debug", "fork_choice"]:
+            # fork-choice dump (the reference's /lighthouse/debug + the v1
+            # debug endpoint): one node per proto-array entry
+            nodes = [
+                {
+                    "slot": str(n.slot),
+                    "block_root": "0x" + bytes(n.root).hex(),
+                    "parent_root": (
+                        "0x" + bytes(chain.fork_choice.proto.nodes[n.parent].root).hex()
+                        if n.parent != -1
+                        else None
+                    ),
+                    "weight": str(n.weight),
+                    "execution_status": n.execution_status,
+                }
+                for n in chain.fork_choice.proto.nodes
+            ]
+            self._send(
+                200,
+                json.dumps(
+                    {
+                        "justified_checkpoint": {
+                            "epoch": str(chain.fork_choice.justified_checkpoint.epoch)
+                        },
+                        "finalized_checkpoint": {
+                            "epoch": str(chain.fork_choice.finalized_checkpoint.epoch)
+                        },
+                        "fork_choice_nodes": nodes,
+                    }
+                ).encode(),
+            )
         elif parts == ["eth", "v1", "config", "spec"]:
             from ..networks import dump_config_dict
 
@@ -223,7 +276,13 @@ class _Handler(BaseHTTPRequestHandler):
                             if not tok:
                                 continue
                             if tok.startswith("0x"):  # pubkey id (spec-legal)
-                                idx = index_by_pk.get(bytes.fromhex(tok[2:]))
+                                try:
+                                    raw = bytes.fromhex(tok[2:])
+                                except ValueError:
+                                    raise ApiError(
+                                        400, f"bad validator id {tok!r}"
+                                    ) from None
+                                idx = index_by_pk.get(raw)
                                 if idx is not None:
                                     wanted.add(idx)
                             elif tok.isdigit():
@@ -463,6 +522,37 @@ class _Handler(BaseHTTPRequestHandler):
             self._publish_batch(
                 body, t.SyncCommitteeMessage, api.publish_sync_message, "sync message"
             )
+        elif (
+            len(parts) == 5
+            and parts[:4] == ["eth", "v1", "beacon", "pool"]
+            and parts[4] in ("voluntary_exits", "proposer_slashings", "attester_slashings")
+        ):
+            # single-object op endpoints: validate against a head-state copy
+            # before pooling (the reference's verify_operation admission);
+            # a StateTransitionError surfaces as do_POST's 400
+            from ..state_transition import per_block
+
+            ssz_type, process_fn, insert_fn = {
+                "voluntary_exits": (
+                    t.SignedVoluntaryExit,
+                    per_block.process_voluntary_exit,
+                    api.op_pool.insert_voluntary_exit,
+                ),
+                "proposer_slashings": (
+                    t.ProposerSlashing,
+                    per_block.process_proposer_slashing,
+                    api.op_pool.insert_proposer_slashing,
+                ),
+                "attester_slashings": (
+                    t.AttesterSlashing,
+                    per_block.process_attester_slashing,
+                    api.op_pool.insert_attester_slashing,
+                ),
+            }[parts[4]]
+            op = decode(body, ssz_type)
+            process_fn(self.chain.head_state().copy(), op, ctx, True)
+            insert_fn(op)
+            self._send(200, b"{}")
         elif parts == ["eth", "v1", "validator", "aggregate_and_proofs"]:
             self._publish_batch(
                 body, t.SignedAggregateAndProof, api.publish_aggregate, "aggregate"

@@ -215,3 +215,55 @@ def test_config_spec_identity_and_validators(server):
     assert rows[0]["status"] == "active_ongoing"
     status, resp = _get(srv, "/eth/v1/beacon/states/head/validators?id=1,3")
     assert [r["index"] for r in resp["data"]] == ["1", "3"]
+
+
+def test_pool_gets_and_fork_choice_dump(server):
+    ctx, chain, srv = server
+    t = ctx.types
+    api = srv.httpd.RequestHandlerClass.api
+    api.op_pool.insert_voluntary_exit(
+        t.SignedVoluntaryExit(
+            message=t.VoluntaryExit(epoch=0, validator_index=2), signature=b"\x00" * 96
+        )
+    )
+    status, resp = _get(srv, "/eth/v1/beacon/pool/voluntary_exits")
+    assert status == 200 and resp["data"][0]["message"]["validator_index"] == "2"
+    status, resp = _get(srv, "/eth/v1/beacon/pool/attestations")
+    assert status == 200
+    status, resp = _get(srv, "/eth/v1/debug/fork_choice")
+    assert status == 200
+    nodes = resp["fork_choice_nodes"]
+    assert nodes and nodes[0]["block_root"].startswith("0x")
+    assert all("execution_status" in n for n in nodes)
+
+
+def test_pool_op_posts_validate(server):
+    """Op POSTs run the per_block validity checks before pooling; invalid
+    ops get a 400 (the reference's verify_operation admission)."""
+    import urllib.error
+
+    import pytest as _pytest
+
+    ctx, chain, srv = server
+    t = ctx.types
+    # invalid exit: validator index out of range
+    bad = t.SignedVoluntaryExit(
+        message=t.VoluntaryExit(epoch=0, validator_index=10**6), signature=b"\x00" * 96
+    )
+    with _pytest.raises(urllib.error.HTTPError) as exc:
+        _post(srv, "/eth/v1/beacon/pool/voluntary_exits", encode(bad, type(bad)))
+    assert exc.value.code == 400
+    # invalid attester slashing: identical attestations are not slashable
+    att = t.IndexedAttestation(
+        attesting_indices=[0],
+        data=t.AttestationData(
+            slot=0, index=0, beacon_block_root=b"\x00" * 32,
+            source=t.Checkpoint(epoch=0, root=b"\x00" * 32),
+            target=t.Checkpoint(epoch=0, root=b"\x00" * 32),
+        ),
+        signature=b"\x00" * 96,
+    )
+    dup = t.AttesterSlashing(attestation_1=att, attestation_2=att)
+    with _pytest.raises(urllib.error.HTTPError) as exc:
+        _post(srv, "/eth/v1/beacon/pool/attester_slashings", encode(dup, type(dup)))
+    assert exc.value.code == 400
