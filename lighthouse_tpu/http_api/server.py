@@ -200,23 +200,27 @@ class _Handler(BaseHTTPRequestHandler):
                     "parent_root": (
                         "0x" + bytes(chain.fork_choice.proto.nodes[n.parent].root).hex()
                         if n.parent != -1
-                        else None
+                        else "0x" + "00" * 32  # anchor: zero root (schema: string)
                     ),
                     "weight": str(n.weight),
                     "execution_status": n.execution_status,
                 }
                 for n in chain.fork_choice.proto.nodes
             ]
+
+            def cp_json(cp):
+                return {"epoch": str(cp.epoch), "root": "0x" + bytes(cp.root).hex()}
+
             self._send(
                 200,
                 json.dumps(
                     {
-                        "justified_checkpoint": {
-                            "epoch": str(chain.fork_choice.justified_checkpoint.epoch)
-                        },
-                        "finalized_checkpoint": {
-                            "epoch": str(chain.fork_choice.finalized_checkpoint.epoch)
-                        },
+                        "justified_checkpoint": cp_json(
+                            chain.fork_choice.justified_checkpoint
+                        ),
+                        "finalized_checkpoint": cp_json(
+                            chain.fork_choice.finalized_checkpoint
+                        ),
                         "fork_choice_nodes": nodes,
                     }
                 ).encode(),
