@@ -308,11 +308,33 @@ def main() -> None:
     run_all = ["--all"] if "--all" in sys.argv else []
     errors = []
 
+    # Fast pre-probe: a wedged tunnel hangs the child's jax import, so a
+    # 90 s device-list probe decides whether the accelerator attempts are
+    # worth their (much larger) budget at all.
+    import subprocess
+
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=probe_timeout, capture_output=True,
+        )
+        accel_alive = probe.returncode == 0
+        if not accel_alive:
+            tail = (probe.stderr or b"").decode(errors="replace").strip().splitlines()
+            errors.append(
+                "probe: backend init failed"
+                + (f": {tail[-1][:200]}" if tail else "")
+            )
+    except subprocess.TimeoutExpired:
+        accel_alive = False
+        errors.append(f"probe: tunnel wedged (no device list in {probe_timeout}s)")
+
     # Attempt 1 + one retry on the default (accelerator) platform. The child
     # import of jax is what wedges when the tunnel is down, so the deadline
     # covers everything. --all needs a longer budget (five configs + oracle).
     budget = int(os.environ.get("BENCH_ACCEL_TIMEOUT", 2400 if run_all else 900))
-    for attempt in range(2):
+    for attempt in range(2 if accel_alive else 0):
         result, err = _run_child({}, budget, run_all)
         if result is not None:
             print(json.dumps(result))

@@ -88,7 +88,10 @@ class BeaconProcessor:
             # FIFO queues drop the NEW item; LIFO queues drop the OLDEST
             # (freshest-first semantics for attestations).
             if work_type in _LIFO_TYPES:
-                q.popleft()
+                try:
+                    q.popleft()
+                except IndexError:
+                    pass  # a concurrent drain already emptied the queue
                 self.stats.bump(self.stats.dropped, work_type)
             else:
                 self.stats.bump(self.stats.dropped, work_type)

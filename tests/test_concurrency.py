@@ -74,8 +74,10 @@ def test_concurrent_submit_and_drain_loses_nothing():
         t.start()
     for t in threads:
         t.join(30)
+        assert not t.is_alive(), "producer timed out"
     stop.set()
     d.join(30)
+    assert not d.is_alive(), "drainer timed out"
     assert not errors, errors
     assert len(drained) == sum(submitted), (len(drained), sum(submitted))
 
