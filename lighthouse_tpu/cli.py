@@ -116,19 +116,30 @@ def cmd_validator_client(args) -> int:
     from .crypto import keystore as ks
     from .crypto import bls as bls_pkg
 
+    import contextlib
+
     bls = bls_pkg.backend(args.bls_backend)
     secret_keys = []
-    if args.keystores:
-        password = args.password or ""
-        for path in args.keystores:
-            secret_keys.append(bls.SecretKey.from_bytes(ks.decrypt(ks.load(path), password)))
-    else:
-        for i in range(args.interop_validators):
-            secret_keys.append(bls.interop_secret_key(i))
-    print(f"validator client: {len(secret_keys)} keys, beacon node {args.beacon_node}")
-    with urllib.request.urlopen(f"{args.beacon_node}/eth/v1/beacon/genesis") as r:
-        genesis = json.load(r)["data"]
-    print(f"connected; genesis time {genesis['genesis_time']}")
+    with contextlib.ExitStack() as locks:
+        if args.keystores:
+            from .validator_client.lockfile import Lockfile
+
+            password = args.password or ""
+            for path in args.keystores:
+                # one lock per keystore: a second VC on the same keys must
+                # refuse to start (common/lockfile — anti-slashing); the
+                # ExitStack unwinds partial acquisitions on any failure
+                locks.enter_context(Lockfile(str(path) + ".lock"))
+                secret_keys.append(
+                    bls.SecretKey.from_bytes(ks.decrypt(ks.load(path), password))
+                )
+        else:
+            for i in range(args.interop_validators):
+                secret_keys.append(bls.interop_secret_key(i))
+        print(f"validator client: {len(secret_keys)} keys, beacon node {args.beacon_node}")
+        with urllib.request.urlopen(f"{args.beacon_node}/eth/v1/beacon/genesis") as r:
+            genesis = json.load(r)["data"]
+        print(f"connected; genesis time {genesis['genesis_time']}")
     return 0
 
 

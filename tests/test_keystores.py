@@ -83,3 +83,23 @@ def test_wallet_derives_sequential_validators():
     assert ks1["path"] == "m/12381/3600/0/0/0"
     sk2 = int.from_bytes(ks.decrypt(ks2, "kpass"), "big")
     assert sk1 != sk2
+
+
+def test_lockfile_excludes_second_holder(tmp_path):
+    """common/lockfile semantics (flock-backed): a held lock excludes
+    others atomically; a dead holder's leftover FILE does not block (the
+    kernel released its lock with the process); release tidies up."""
+    from lighthouse_tpu.validator_client.lockfile import Lockfile, LockfileError
+
+    path = tmp_path / "voting-keystore.json.lock"
+    lock = Lockfile(path).acquire()
+    with pytest.raises(LockfileError):
+        Lockfile(path).acquire()  # held (flock conflict, same process)
+    lock.release()
+    assert not path.exists()
+
+    # leftover file from a dead process: no flock holder -> acquirable
+    path.write_text("999999999")
+    with Lockfile(path):
+        assert path.read_text().strip() != "999999999"
+    assert not path.exists()
