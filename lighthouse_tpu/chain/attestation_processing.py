@@ -251,16 +251,19 @@ def batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: bool
     chain.observed_aggregates.prune(current_slot, ctx.preset.slots_per_epoch + 2)
 
     results: list = [None] * len(aggregates)
-    staged = []  # (index, indexed_attestation, [three sets], agg_root)
+    staged = []  # (index, signed_aggregate, indexed_attestation, [three sets], data_root)
     for i, signed in enumerate(aggregates):
         try:
             msg = signed.message
             att = msg.aggregate
             _common_attestation_checks(chain, att, current_slot)
-            # observed_aggregates.rs AttestationKnown: identical aggregate
-            # already seen this slot
-            agg_root = type(att).hash_tree_root(att)
-            if chain.observed_aggregates.is_observed(int(att.data.slot), agg_root):
+            # observed_aggregates.rs AttestationKnown: an aggregate whose
+            # participation is a (non-strict) subset of one already seen
+            # this slot carries nothing new
+            data_root = type(att.data).hash_tree_root(att.data)
+            if chain.observed_aggregates.is_observed(
+                int(att.data.slot), data_root, att.aggregation_bits
+            ):
                 raise AttestationError("aggregate already known")
             # observed_attesters.rs AggregatorAlreadyKnown
             if _safe_observed(
@@ -291,7 +294,7 @@ def batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: bool
                     state, indexed, ctx.bls, resolver, ctx.preset, ctx.spec
                 ),
             ]
-            staged.append((i, signed, indexed, sets, agg_root))
+            staged.append((i, signed, indexed, sets, data_root))
         except (AttestationError, StateTransitionError) as e:
             results[i] = e
 
@@ -308,9 +311,13 @@ def batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: bool
                     else AttestationError("invalid signature")
                 )
 
-    for i, signed, indexed, _, agg_root in staged:
+    for i, signed, indexed, _, data_root in staged:
         if results[i] is True:
-            chain.observed_aggregates.observe(int(indexed.data.slot), agg_root)
+            chain.observed_aggregates.observe(
+                int(indexed.data.slot),
+                data_root,
+                signed.message.aggregate.aggregation_bits,
+            )
             _safe_observe(
                 chain.observed_aggregators,
                 int(indexed.data.target.epoch),

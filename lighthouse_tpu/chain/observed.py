@@ -16,11 +16,10 @@ Semantics preserved:
     observation flags equivocation regardless of the block root — the
     dedup-by-root case is handled by the store before this cache is asked.
 
-Simplification vs the reference (documented): ObservedAggregates stores
-hash_tree_root(attestation) per slot rather than the non-strict-subset
-bitfield comparison of observed_aggregates.rs — byte-identical repeats are
-dropped; a strictly-smaller subset aggregate is re-verified instead of
-dropped (safe, just less thrifty).
+ObservedAggregates implements observed_aggregates.rs's non-strict-subset
+semantics: per (slot, attestation-data root), an aggregate whose
+participation bitfield is covered by one already seen is dropped; only
+aggregates carrying new participation are admitted.
 """
 
 from __future__ import annotations
@@ -82,34 +81,58 @@ class ObservedAggregators(_EpochIndexContainer):
 
 
 class ObservedAggregates:
-    """Seen aggregate-attestation roots per slot (observed_aggregates.rs)."""
+    """Seen aggregate attestations per slot (observed_aggregates.rs).
+
+    Keyed by the ATTESTATION DATA root, storing each seen aggregation
+    bitfield: a new aggregate whose participation is a NON-STRICT SUBSET
+    of one already seen carries no new information and is dropped —
+    the reference's is_non_strict_subset check, not just byte-identity."""
 
     def __init__(self):
-        self._by_slot: dict[int, set[bytes]] = defaultdict(set)
+        # slot -> data_root -> list of seen bitfields (as int bitmasks)
+        self._by_slot: dict[int, dict[bytes, list[int]]] = defaultdict(dict)
+        self._count_by_slot: dict[int, int] = defaultdict(int)
         self.lowest_permissible_slot = 0
 
-    def observe(self, slot: int, root: bytes) -> bool:
-        slot, root = int(slot), bytes(root)
+    @staticmethod
+    def _mask(bits) -> int:
+        mask = 0
+        for i, bit in enumerate(bits):
+            if bit:
+                mask |= 1 << i
+        return mask
+
+    def observe(self, slot: int, data_root: bytes, aggregation_bits) -> bool:
+        """Record the aggregate; True when it was already covered (subset
+        of a previously seen bitfield)."""
+        slot, data_root = int(slot), bytes(data_root)
         if slot < self.lowest_permissible_slot:
             return True  # too old to matter: treat as seen
-        bucket = self._by_slot[slot]
-        if root in bucket:
-            return True
-        if len(bucket) >= MAX_OBSERVATIONS_PER_SLOT:
+        mask = self._mask(aggregation_bits)
+        bucket = self._by_slot[slot].get(data_root)
+        if bucket is not None and any(mask | seen == seen for seen in bucket):
+            return True  # non-strict subset of a seen bitfield
+        if self._count_by_slot[slot] >= MAX_OBSERVATIONS_PER_SLOT:
             return True  # DoS guard: refuse to grow; drop the aggregate
-        bucket.add(root)
+        if bucket is None:
+            bucket = self._by_slot[slot][data_root] = []
+        bucket.append(mask)
+        self._count_by_slot[slot] += 1
         return False
 
-    def is_observed(self, slot: int, root: bytes) -> bool:
+    def is_observed(self, slot: int, data_root: bytes, aggregation_bits) -> bool:
         if int(slot) < self.lowest_permissible_slot:
             return True
-        return bytes(root) in self._by_slot.get(int(slot), ())
+        bucket = self._by_slot.get(int(slot), {}).get(bytes(data_root), ())
+        mask = self._mask(aggregation_bits)
+        return any(mask | seen == seen for seen in bucket)
 
     def prune(self, current_slot: int, keep_slots: int) -> None:
         floor = max(0, int(current_slot) - int(keep_slots))
         self.lowest_permissible_slot = max(self.lowest_permissible_slot, floor)
         for s in [s for s in self._by_slot if s < self.lowest_permissible_slot]:
             del self._by_slot[s]
+            self._count_by_slot.pop(s, None)
 
 
 class ObservedBlockProducers:

@@ -213,6 +213,9 @@ class BeaconNodeApi:
         self.op_pool = op_pool or OperationPool(chain.ctx)
         self.sync_pool = SyncMessagePool(chain.ctx)
         self._sync_committee_cache: dict[int, list[bytes]] = {}
+        # (slot, head_root) -> (source cp, target epoch, target root):
+        # the attester_cache.rs role (one state advance per slot+head)
+        self._att_data_cache: dict = {}
 
     # duties (http_api validator/duties/{attester,proposer})
     def attester_duties(self, epoch: int, pubkeys: list[bytes]) -> list[AttesterDuty]:
@@ -282,20 +285,35 @@ class BeaconNodeApi:
 
     # attestation production/publish (validator/attestation_data + POST)
     def attestation_data(self, slot: int, committee_index: int):
+        """AttestationData for a duty. The (source, target) pair depends
+        only on (slot, head) — NOT the committee index — so it is computed
+        once per slot+head and served to every committee from the cache
+        (attester_cache.rs: 'the data is identical for all validators of a
+        slot'; state_at_slot's state copy is the expensive part)."""
         ctx = self.chain.ctx
         head_root = self.chain.head_root
-        state = self.chain.state_at_slot(slot)
-        epoch = compute_epoch_at_slot(slot, ctx.preset)
-        start_slot = compute_start_slot_at_epoch(epoch, ctx.preset)
-        if start_slot == slot or state.slot <= start_slot:
-            target_root = head_root
-        else:
-            target_root = state.block_roots[start_slot % ctx.preset.slots_per_historical_root]
+        key = (int(slot), bytes(head_root))
+        hit = self._att_data_cache.get(key)
+        if hit is None:
+            state = self.chain.state_at_slot(slot)
+            epoch = compute_epoch_at_slot(slot, ctx.preset)
+            start_slot = compute_start_slot_at_epoch(epoch, ctx.preset)
+            if start_slot == slot or state.slot <= start_slot:
+                target_root = head_root
+            else:
+                target_root = bytes(
+                    state.block_roots[start_slot % ctx.preset.slots_per_historical_root]
+                )
+            hit = (state.current_justified_checkpoint, epoch, target_root)
+            if len(self._att_data_cache) > 64:
+                self._att_data_cache.clear()
+            self._att_data_cache[key] = hit
+        source, epoch, target_root = hit
         return ctx.types.AttestationData(
             slot=slot,
             index=committee_index,
             beacon_block_root=head_root,
-            source=state.current_justified_checkpoint,
+            source=source,
             target=Checkpoint(epoch=epoch, root=target_root),
         )
 

@@ -39,13 +39,17 @@ def test_epoch_container_dedup_and_pruning():
     assert len(c) == 1  # only epoch 10 survives
 
 
-def test_observed_aggregates_root_dedup():
+def test_observed_aggregates_subset_dedup():
     c = ObservedAggregates()
-    assert c.observe(5, b"\x01" * 32) is False
-    assert c.observe(5, b"\x01" * 32) is True
-    assert c.is_observed(5, b"\x01" * 32)
+    root = b"\x01" * 32
+    assert c.observe(5, root, [1, 1, 0, 0]) is False
+    assert c.observe(5, root, [1, 1, 0, 0]) is True  # identical
+    assert c.observe(5, root, [1, 0, 0, 0]) is True  # non-strict subset
+    assert c.is_observed(5, root, [0, 1, 0, 0])
+    assert c.observe(5, root, [1, 1, 1, 0]) is False  # superset: new info
+    assert c.observe(5, b"\x02" * 32, [1, 0, 0, 0]) is False  # other data
     c.prune(40, keep_slots=8)
-    assert c.observe(5, b"\x02" * 32) is True  # below floor: treated as seen
+    assert c.observe(5, b"\x03" * 32, [1]) is True  # below floor: seen
 
 
 def test_observed_block_producers_equivocation_and_prune():
