@@ -190,6 +190,28 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             else:
                 raise ApiError(404, "unknown pool resource")
+        elif parts == ["eth", "v2", "debug", "beacon", "heads"]:
+            # viable fork-choice leaves: EL-refuted forks are NOT heads
+            proto = chain.fork_choice.proto
+            children = {n.parent for n in proto.nodes if n.parent != -1}
+            heads = [
+                {"slot": str(n.slot), "root": "0x" + bytes(n.root).hex(),
+                 "execution_optimistic": n.execution_status == "optimistic"}
+                for i, n in enumerate(proto.nodes)
+                if i not in children and n.execution_status != "invalid"
+            ]
+            self._send(200, _data(heads))
+        elif (
+            len(parts) == 6
+            and parts[:4] == ["eth", "v1", "beacon", "blocks"]
+            and parts[5] == "root"
+        ):
+            root = (
+                chain.head_root if parts[4] == "head" else _parse_root(parts[4], "block")
+            )
+            if chain.store.get_block(root) is None and root != chain.genesis_block_root:
+                raise ApiError(404, "block not found")
+            self._send(200, _data({"root": "0x" + root.hex()}))
         elif parts == ["eth", "v1", "debug", "fork_choice"]:
             # fork-choice dump (the reference's /lighthouse/debug + the v1
             # debug endpoint): one node per proto-array entry
@@ -317,6 +339,47 @@ class _Handler(BaseHTTPRequestHandler):
                             "validator": encode(v, type(v)),
                         }
                     )
+                self._send(200, _data(out))
+            elif parts[5] == "committees":
+                # /eth/v1/beacon/states/{id}/committees[?epoch=&slot=&index=]
+                from ..state_transition.helpers import (
+                    get_beacon_committee,
+                    get_committee_count_per_slot,
+                )
+
+                state_epoch = compute_epoch_at_slot(int(state.slot), ctx.preset)
+                epoch = int(q["epoch"][0]) if "epoch" in q else state_epoch
+                # the shuffling is determined for previous/current/next epoch
+                # of this state; anything else needs a different state id
+                if not state_epoch - 1 <= epoch <= state_epoch + 1:
+                    raise ApiError(
+                        400, f"epoch {epoch} outside this state's shuffling horizon"
+                    )
+                spe = ctx.preset.slots_per_epoch
+                n = get_committee_count_per_slot(state, epoch, ctx.preset)
+                slots = (
+                    [int(q["slot"][0])]
+                    if "slot" in q
+                    else range(epoch * spe, (epoch + 1) * spe)
+                )
+                indices = [int(q["index"][0])] if "index" in q else range(n)
+                out = []
+                for slot in slots:
+                    if compute_epoch_at_slot(slot, ctx.preset) != epoch:
+                        raise ApiError(400, f"slot {slot} is not in epoch {epoch}")
+                    for ci in indices:
+                        if ci >= n:
+                            raise ApiError(400, f"committee index {ci} out of range")
+                        committee = get_beacon_committee(
+                            state, slot, ci, ctx.preset, ctx.spec
+                        )
+                        out.append(
+                            {
+                                "index": str(ci),
+                                "slot": str(slot),
+                                "validators": [str(v) for v in committee],
+                            }
+                        )
                 self._send(200, _data(out))
             elif parts[5] == "sync_committees":
                 if ctx.types.fork_of(state) == "phase0":

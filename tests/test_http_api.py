@@ -267,3 +267,41 @@ def test_pool_op_posts_validate(server):
     with _pytest.raises(urllib.error.HTTPError) as exc:
         _post(srv, "/eth/v1/beacon/pool/attester_slashings", encode(dup, type(dup)))
     assert exc.value.code == 400
+
+
+def test_committees_heads_and_block_root(server):
+    ctx, chain, srv = server
+    status, resp = _get(srv, "/eth/v1/beacon/states/head/committees")
+    assert status == 200
+    rows = resp["data"]
+    assert rows and all({"index", "slot", "validators"} <= set(r) for r in rows)
+    all_validators = sorted(int(v) for r in rows for v in r["validators"])
+    # every active validator appears exactly once per epoch
+    assert all_validators == list(range(len(chain.head_state().validators)))
+    one_slot = _get(srv, "/eth/v1/beacon/states/head/committees?slot=1")[1]["data"]
+    assert all(r["slot"] == "1" for r in one_slot)
+
+    status, resp = _get(srv, "/eth/v2/debug/beacon/heads")
+    assert status == 200
+    assert any(r["root"] == "0x" + chain.head_root.hex() for r in resp["data"])
+
+    status, resp = _get(srv, "/eth/v1/beacon/blocks/head/root")
+    assert status == 200 and resp["data"]["root"] == "0x" + chain.head_root.hex()
+
+
+def test_committees_validation(server):
+    ctx, chain, srv = server
+    import urllib.error
+
+    for bad in (
+        "/eth/v1/beacon/states/head/committees?epoch=99",
+        "/eth/v1/beacon/states/head/committees?slot=999",
+        "/eth/v1/beacon/states/head/committees?index=99",
+    ):
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get(srv, bad)
+        assert exc.value.code == 400, bad
+    # next-epoch lookahead is allowed (duty planning)
+    spe = ctx.preset.slots_per_epoch
+    status, resp = _get(srv, f"/eth/v1/beacon/states/head/committees?epoch=1&slot={spe}")
+    assert status == 200 and all(r["slot"] == str(spe) for r in resp["data"])
