@@ -37,7 +37,11 @@ def cmd_beacon_node(args) -> int:
     from .client import Client, ClientConfig
 
     spec_override = None
+    genesis_state_path = None
     if args.testnet_dir:
+        gpath = pathlib.Path(args.testnet_dir) / "genesis.ssz"
+        if gpath.exists():
+            genesis_state_path = str(gpath)
         from .networks import load_config_yaml, network_config
         from .types import MAINNET_SPEC, MINIMAL_SPEC
 
@@ -54,6 +58,7 @@ def cmd_beacon_node(args) -> int:
         preset=args.preset,
         network=args.network,
         spec_override=spec_override,
+        genesis_state_path=genesis_state_path,
         bls_backend=args.bls_backend,
         datadir=args.datadir,
         http_port=args.http_port,
@@ -257,6 +262,47 @@ def cmd_lcli(args) -> int:
             f.write(enr)
         print(enr)
         return 0
+    if args.lcli_cmd == "new-testnet":
+        # lcli/src/new_testnet.rs: write a testnet directory (config.yaml +
+        # genesis.ssz) consumable by `beacon-node --testnet-dir`
+        import dataclasses as _dc
+
+        from .networks import dump_config_yaml
+        from .state_transition import interop_genesis_state as _genesis
+
+        out = pathlib.Path(args.testnet_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        overrides = {"altair_fork_epoch": args.altair_fork_epoch}
+        if args.bellatrix_fork_epoch is not None:
+            overrides["bellatrix_fork_epoch"] = args.bellatrix_fork_epoch
+        spec = _dc.replace(ctx.spec, **overrides)
+        (out / "config.yaml").write_text(dump_config_yaml(spec))
+        state = _genesis(args.validators, args.genesis_time, _dc.replace(ctx, spec=spec))
+        (out / "genesis.ssz").write_bytes(type(state).serialize(state))
+        root = type(state).hash_tree_root(state)
+        print(f"testnet dir {out}: config.yaml + genesis.ssz (root 0x{root.hex()})")
+        return 0
+    if args.lcli_cmd == "insecure-validators":
+        # lcli/src/insecure_validators.rs: interop keystores on disk for
+        # testnets (NOT for real money — the password is the index)
+        from .crypto import keystore as ks_mod
+
+        out = pathlib.Path(args.output_dir)
+        out.mkdir(parents=True, exist_ok=True)
+        for i in range(args.count):
+            sk = ctx.bls.interop_secret_key(i)
+            # deliberately weak KDF + index password: testnet keys only
+            store = ks_mod.encrypt(
+                sk.to_bytes(),
+                password=str(i),
+                pubkey=sk.public_key().to_bytes().hex(),
+                kdf_function="pbkdf2",
+                kdf_params={"c": 2, "dklen": 32},
+            )
+            path = out / f"validator_{i}.json"
+            ks_mod.save(store, str(path))
+            print(f"wrote {path} pubkey 0x{sk.public_key().to_bytes().hex()[:16]}...")
+        return 0
     raise SystemExit(f"unknown lcli command {args.lcli_cmd}")
 
 
@@ -375,6 +421,15 @@ def build_parser() -> argparse.ArgumentParser:
     ge.add_argument("--ip", default="127.0.0.1")
     ge.add_argument("--port", type=int, default=9000)
     ge.add_argument("--output", required=True)
+    nt = lc_sub.add_parser("new-testnet")
+    nt.add_argument("--testnet-dir", required=True)
+    nt.add_argument("--validators", type=int, default=16)
+    nt.add_argument("--genesis-time", type=int, default=1600000000)
+    nt.add_argument("--altair-fork-epoch", type=int, default=0)
+    nt.add_argument("--bellatrix-fork-epoch", type=int, default=None)
+    iv = lc_sub.add_parser("insecure-validators")
+    iv.add_argument("--count", type=int, required=True)
+    iv.add_argument("--output-dir", required=True)
     ps = lc_sub.add_parser("pretty-ssz")
     ps.add_argument("--type", required=True)
     ps.add_argument("--file", required=True)

@@ -49,6 +49,9 @@ class ClientConfig:
     # overrides `preset`'s default spec
     network: str | None = None
     spec_override: object = None
+    # explicit genesis state (a testnet dir's genesis.ssz): overrides the
+    # interop genesis when booting fresh
+    genesis_state_path: str | None = None
 
 
 class Client:
@@ -98,6 +101,11 @@ class Client:
             resumed = genesis_state is not None
         if not resumed and config.checkpoint_url:
             genesis_state = self._fetch_checkpoint_state(config.checkpoint_url, ctx)
+        elif not resumed and config.genesis_state_path:
+            from .types import decode_beacon_state
+
+            with open(config.genesis_state_path, "rb") as f:
+                genesis_state = decode_beacon_state(f.read(), ctx.types, ctx.spec)
         elif not resumed:
             genesis_state = interop_genesis_state(
                 config.interop_validators, config.genesis_time, ctx

@@ -78,6 +78,22 @@ class _Handler(BaseHTTPRequestHandler):
     def _error(self, status: int, message: str):
         self._send(status, json.dumps({"code": status, "message": message}).encode())
 
+    def _block_root_for(self, block_id: str) -> bytes:
+        """Resolve a block id (head / genesis / finalized / 0x-root) to a
+        root KNOWN to this chain, 404 otherwise — the shared front half of
+        every block route."""
+        chain = self.chain
+        if block_id == "head":
+            return chain.head_root
+        if block_id == "genesis":
+            return chain.genesis_block_root
+        if block_id == "finalized":
+            return bytes(chain.fork_choice.finalized_checkpoint.root)
+        root = _parse_root(block_id, "block")
+        if chain.store.get_block(root) is None and root != chain.genesis_block_root:
+            raise ApiError(404, "block not found")
+        return root
+
     def _state_for(self, state_id: str):
         chain = self.chain
         if state_id in ("head", "justified", "finalized"):
@@ -193,7 +209,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif parts == ["eth", "v2", "debug", "beacon", "heads"]:
             # viable fork-choice leaves: EL-refuted forks are NOT heads
             proto = chain.fork_choice.proto
-            children = {n.parent for n in proto.nodes if n.parent != -1}
+            # an EL-invalid child must not hide its valid parent from the
+            # head list (nor appear itself)
+            children = {
+                n.parent
+                for n in proto.nodes
+                if n.parent != -1 and n.execution_status != "invalid"
+            }
             heads = [
                 {"slot": str(n.slot), "root": "0x" + bytes(n.root).hex(),
                  "execution_optimistic": n.execution_status == "optimistic"}
@@ -206,11 +228,7 @@ class _Handler(BaseHTTPRequestHandler):
             and parts[:4] == ["eth", "v1", "beacon", "blocks"]
             and parts[5] == "root"
         ):
-            root = (
-                chain.head_root if parts[4] == "head" else _parse_root(parts[4], "block")
-            )
-            if chain.store.get_block(root) is None and root != chain.genesis_block_root:
-                raise ApiError(404, "block not found")
+            root = self._block_root_for(parts[4])
             self._send(200, _data({"root": "0x" + root.hex()}))
         elif parts == ["eth", "v1", "debug", "fork_choice"]:
             # fork-choice dump (the reference's /lighthouse/debug + the v1
@@ -397,11 +415,8 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 raise ApiError(404, "unknown state endpoint")
         elif len(parts) == 5 and parts[:4] == ["eth", "v1", "beacon", "headers"]:
-            block_id = parts[4]
-            root = chain.head_root if block_id == "head" else _parse_root(block_id, "block")
+            root = self._block_root_for(parts[4])
             signed = chain.store.get_block(root)
-            if signed is None and root != chain.genesis_block_root:
-                raise ApiError(404, "block not found")
             if signed is None:
                 # genesis: rebuild the header with state_root filled so
                 # hash_tree_root(header) == the returned root (the same
@@ -486,13 +501,10 @@ class _Handler(BaseHTTPRequestHandler):
             )
         elif len(parts) == 5 and parts[:4] == ["eth", "v2", "beacon", "blocks"]:
             # fork-versioned block envelope (the v2 block endpoint)
-            root = (
-                self.chain.head_root
-                if parts[4] == "head"
-                else _parse_root(parts[4], "block id")
-            )
+            root = self._block_root_for(parts[4])
             signed = self.chain.store.get_block(root)
             if signed is None:
+                # genesis has no SignedBeaconBlock to serialize
                 raise ApiError(404, "block not found")
             self._send(
                 200,

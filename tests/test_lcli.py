@@ -55,3 +55,49 @@ def test_lcli_check_deposit_data(tmp_path):
          "check-deposit-data", "--file", str(p)]
     )
     assert rc == 0
+
+
+def test_lcli_new_testnet_boots_a_node(tmp_path):
+    """new-testnet writes a dir the beacon node consumes end to end."""
+    td = tmp_path / "net"
+    rc = main(
+        ["lcli", "--preset", "minimal", "--bls-backend", "fake", "new-testnet",
+         "--testnet-dir", str(td), "--validators", "8",
+         "--altair-fork-epoch", "0"]
+    )
+    assert rc == 0
+    assert (td / "config.yaml").exists() and (td / "genesis.ssz").exists()
+    rc = main(
+        ["beacon-node", "--preset", "minimal", "--bls-backend", "fake",
+         "--testnet-dir", str(td), "--interop-validators", "8",
+         "--run-slots", "1", "--http-port", "0"]
+    )
+    assert rc == 0
+    # the node consumed the DIR's genesis.ssz (same root the tool wrote),
+    # not a freshly built interop genesis with wall-clock genesis_time
+    from lighthouse_tpu.client import Client, ClientConfig
+    from lighthouse_tpu.networks import load_config_yaml
+    from lighthouse_tpu.types import MINIMAL_SPEC, decode_beacon_state
+    from lighthouse_tpu.types.containers import minimal_types
+
+    spec = load_config_yaml(td / "config.yaml", base=MINIMAL_SPEC)
+    c = Client(ClientConfig(preset="minimal", bls_backend="fake", http_enabled=False,
+                            spec_override=spec, genesis_state_path=str(td / "genesis.ssz")))
+    written = decode_beacon_state((td / "genesis.ssz").read_bytes(), minimal_types(), spec)
+    assert c.chain.head_state().genesis_time == written.genesis_time == 1600000000
+
+
+def test_lcli_insecure_validators_roundtrip(tmp_path):
+    from lighthouse_tpu.crypto import bls as bls_pkg
+    from lighthouse_tpu.crypto import keystore as ks
+
+    out = tmp_path / "keys"
+    rc = main(
+        ["lcli", "--preset", "minimal", "--bls-backend", "fake",
+         "insecure-validators", "--count", "3", "--output-dir", str(out)]
+    )
+    assert rc == 0
+    bls = bls_pkg.backend("fake")
+    for i in range(3):
+        secret = ks.decrypt(ks.load(str(out / f"validator_{i}.json")), str(i))
+        assert secret == bls.interop_secret_key(i).to_bytes()
