@@ -141,11 +141,65 @@ def cmd_validator_client(args) -> int:
         else:
             for i in range(args.interop_validators):
                 secret_keys.append(bls.interop_secret_key(i))
-        print(f"validator client: {len(secret_keys)} keys, beacon node {args.beacon_node}")
-        with urllib.request.urlopen(f"{args.beacon_node}/eth/v1/beacon/genesis") as r:
-            genesis = json.load(r)["data"]
-        print(f"connected; genesis time {genesis['genesis_time']}")
+        urls = args.beacon_nodes or ["http://127.0.0.1:5052"]
+        print(f"validator client: {len(secret_keys)} keys, beacon nodes {urls}")
+
+        # duties over the typed HTTP client (common/eth2 +
+        # beacon_node_fallback.rs): the VC is a pure API consumer — the
+        # genesis fetch goes through the same fallback transport
+        from .validator_client import (
+            BeaconApiError,
+            BeaconNodeHttpClient,
+            ValidatorClient,
+            ValidatorStore,
+        )
+
+        ctx = _ctx_for(args)
+        client = BeaconNodeHttpClient(urls, ctx)
+        genesis = client.genesis()
+        genesis_time = int(genesis["genesis_time"])
+        print(f"connected; genesis time {genesis_time}")
+        store = ValidatorStore(ctx)
+        for sk in secret_keys:
+            store.add_validator(sk)
+        vc = ValidatorClient(client, store)
+
+        if args.run_slots is not None:
+            start = int(client.syncing()["head_slot"])
+            for slot in range(start + 1, start + args.run_slots + 1):
+                summary = vc.on_slot(slot)
+                print(f"slot {slot}: {summary}")
+            return 0
+        # production pacing: the wall clock + genesis_time define the slot
+        # (slot_clock.rs), so duty latency cannot accumulate drift; a
+        # transient all-BN outage is logged and ridden out, never fatal
+        spe = ctx.spec.seconds_per_slot
+        last_done = -1
+        try:
+            while True:
+                slot = max(0, (int(time.time()) - genesis_time) // spe)
+                if slot <= last_done:
+                    time.sleep(max(0.2, (genesis_time + (slot + 1) * spe) - time.time()))
+                    continue
+                try:
+                    summary = vc.on_slot(slot)
+                    print(f"slot {slot}: {summary}")
+                except BeaconApiError as e:
+                    print(f"slot {slot}: beacon nodes unavailable ({e}); retrying")
+                last_done = slot
+        except KeyboardInterrupt:
+            pass
     return 0
+
+
+def _ctx_for(args):
+    from .state_transition import TransitionContext
+
+    return (
+        TransitionContext.minimal(args.bls_backend)
+        if args.preset == "minimal"
+        else TransitionContext.mainnet(args.bls_backend)
+    )
 
 
 def cmd_account_manager(args) -> int:
@@ -172,13 +226,9 @@ def cmd_account_manager(args) -> int:
 
 
 def cmd_lcli(args) -> int:
-    from .state_transition import TransitionContext, interop_genesis_state, process_slots
+    from .state_transition import interop_genesis_state, process_slots
 
-    ctx = (
-        TransitionContext.minimal(args.bls_backend)
-        if args.preset == "minimal"
-        else TransitionContext.mainnet(args.bls_backend)
-    )
+    ctx = _ctx_for(args)
     if args.lcli_cmd == "interop-genesis":
         state = interop_genesis_state(args.validators, args.genesis_time, ctx)
         data = type(state).serialize(state)
@@ -367,10 +417,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     vc = sub.add_parser("validator-client", help="run a validator client")
     _add_common(vc)
-    vc.add_argument("--beacon-node", default="http://127.0.0.1:5052")
+    vc.add_argument(
+        "--beacon-node", dest="beacon_nodes", action="append", default=[],
+        help="beacon node URL (repeatable: health-ordered fallback)",
+    )
     vc.add_argument("--keystores", nargs="*")
     vc.add_argument("--password")
     vc.add_argument("--interop-validators", type=int, default=0)
+    vc.add_argument("--run-slots", type=int, help="run N duty slots then exit (testing)")
     vc.set_defaults(fn=cmd_validator_client)
 
     am = sub.add_parser("account-manager", help="wallet and validator keys")

@@ -230,6 +230,13 @@ class BeaconNodeHttpClient:
         except BeaconApiError:
             return False
 
+    def genesis(self) -> dict:
+        """/eth/v1/beacon/genesis (string-valued payload)."""
+        return self._get_json("/eth/v1/beacon/genesis")["data"]
+
+    def syncing(self) -> dict:
+        return self._get_json("/eth/v1/node/syncing")["data"]
+
     def health(self) -> list[bool]:
         """Per-candidate liveness probe (/eth/v1/node/health)."""
         out = []

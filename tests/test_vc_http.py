@@ -91,3 +91,21 @@ def test_http_client_raises_when_all_down():
     )
     with pytest.raises(BeaconApiError):
         client.proposer_duties(0)
+
+
+def test_vc_binary_runs_duties_over_http(bn):
+    """The validator-client BINARY (cli entry) drives real duty slots
+    against a live BN over HTTP (--run-slots testing profile)."""
+    from lighthouse_tpu.cli import main
+
+    ctx, chain, server = bn
+    chain.slot_clock.set_slot(5)  # the BN's wall clock is ahead
+    rc = main(
+        [
+            "validator-client", "--preset", "minimal", "--bls-backend", "fake",
+            "--beacon-node", f"http://127.0.0.1:{server.port}",
+            "--interop-validators", "8", "--run-slots", "2",
+        ]
+    )
+    assert rc == 0
+    assert int(chain.head_state().slot) >= 2, "blocks proposed over the wire"
