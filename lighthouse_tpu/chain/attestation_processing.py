@@ -98,11 +98,14 @@ def batch_verify_gossip_attestations(chain, attestations, apply_to_fork_choice: 
     Returns a list aligned with `attestations`: True for accepted, or an
     Exception describing the rejection. Accepted attestations are applied
     to fork choice when `apply_to_fork_choice`."""
-    results, staged = _stage_gossip_attestations(chain, attestations)
-    batch_ok = bool(staged) and chain.ctx.bls.verify_signature_sets(
-        [s for _, _, s in staged]
-    )
-    return _resolve_and_apply(chain, results, staged, batch_ok, apply_to_fork_choice)
+    from ..common.tracing import span
+
+    with span("gossip_attestation_verify"):
+        results, staged = _stage_gossip_attestations(chain, attestations)
+        batch_ok = bool(staged) and chain.ctx.bls.verify_signature_sets(
+            [s for _, _, s in staged]
+        )
+        return _resolve_and_apply(chain, results, staged, batch_ok, apply_to_fork_choice)
 
 
 class PipelinedGossipVerifier:
@@ -241,6 +244,16 @@ def batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: bool
     (3*N sets), with the same per-aggregate poisoning fallback as the
     unaggregated path. Returns a list aligned with `aggregates`: True or an
     Exception."""
+    from ..common.tracing import span
+
+    # the span covers the WHOLE admission (staging + verify + application),
+    # matching gossip_attestation_verify's scope so the two stage metrics
+    # are comparable; the BLS-only cost is the nested bls_batch_verify span
+    with span("gossip_aggregate_verify"):
+        return _batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice)
+
+
+def _batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: bool):
     from ..state_transition.helpers import get_beacon_committee, is_aggregator
 
     ctx = chain.ctx

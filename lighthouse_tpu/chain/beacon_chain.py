@@ -42,13 +42,16 @@ class BlockError(Exception):
 
 class BeaconChain:
     def __init__(self, genesis_state, ctx: TransitionContext, store=None, slot_clock=None):
-        from .events import EventBus, ValidatorMonitor
+        from .events import EventBus
+        from .validator_monitor import ValidatorMonitor
 
         self.ctx = ctx
         self.store = store if store is not None else MemoryStore()
         self.slot_clock = slot_clock if slot_clock is not None else ManualSlotClock()
         self.events = EventBus()
-        self.validator_monitor = ValidatorMonitor()
+        self.validator_monitor = ValidatorMonitor(
+            slots_per_epoch=ctx.preset.slots_per_epoch
+        )
         # callables (validator_index, target_epoch) invoked for every
         # attestation seen in imported blocks or accepted from gossip —
         # the doppelganger service's liveness feed (doppelganger_service.rs)
@@ -116,6 +119,7 @@ class BeaconChain:
         strategy: BlockSignatureStrategy = BlockSignatureStrategy.VERIFY_BULK,
     ) -> bytes:
         from ..common.metrics import BLOCK_IMPORT_SECONDS
+        from ..common.tracing import span
 
         t = self.ctx.types
         block = signed_block.message
@@ -124,16 +128,20 @@ class BeaconChain:
         if parent_state is None:
             raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
 
-        with BLOCK_IMPORT_SECONDS.time():
-            state = parent_state.copy()
-            try:
-                state_transition(state, signed_block, self.ctx, strategy=strategy)
-            except StateTransitionError as e:
-                raise BlockError(str(e)) from e
+        # the root trace of the import pipeline: signature verification
+        # shows up inside state_transition as the backend's bls spans;
+        # store/fork-choice children come from _post_import
+        with BLOCK_IMPORT_SECONDS.time(), span("block_import"):
+            with span("state_transition"):
+                state = parent_state.copy()
+                try:
+                    state_transition(state, signed_block, self.ctx, strategy=strategy)
+                except StateTransitionError as e:
+                    raise BlockError(str(e)) from e
 
-        block_root = type(block).hash_tree_root(block)
-        self._post_import(block_root, signed_block, state)
-        self.recompute_head()
+            block_root = type(block).hash_tree_root(block)
+            self._post_import(block_root, signed_block, state)
+            self.recompute_head()
         return block_root
 
     def _post_import(
@@ -144,34 +152,69 @@ class BeaconChain:
         Does NOT recompute the head — batch importers do that once.
         `execution_status` must be captured at transition time for batch
         imports (the engine's last_status is per-call mutable state)."""
+        from ..common.tracing import span
+        from ..state_transition.helpers import get_block_root_at_slot
+
         t = self.ctx.types
+        preset = self.ctx.preset
         block = signed_block.message
         # the block carried a valid proposer signature: record (slot,
         # proposer) for the gossip equivocation guard
         # (observed_block_producers.rs)
         self.observed_block_producers.observe(int(block.slot), int(block.proposer_index))
-        self.store.put_block(block_root, signed_block)
-        self.store.put_state(block_root, state)
+        with span("store_write"):
+            self.store.put_block(block_root, signed_block)
+            self.store.put_state(block_root, state)
         self.events.emit(
             "block", slot=int(block.slot), block="0x" + block_root.hex()
         )
         self.validator_monitor.on_block_proposed(int(block.proposer_index), int(block.slot))
 
         # fork choice: the block, then every attestation it carries
-        self.fork_choice.on_tick(max(self.slot(), block.slot))
-        if execution_status is None:
-            execution_status = self._execution_status_of(block)
-        self.fork_choice.on_block(block, block_root, state, execution_status=execution_status)
-        for att in block.body.attestations:
-            indexed = get_indexed_attestation(state, att, t, self.ctx.preset, self.ctx.spec)
-            for vi in indexed.attesting_indices:
-                self.validator_monitor.on_attestation_included(int(vi), int(att.data.slot))
-                for obs in self.attestation_observers:
-                    obs(int(vi), int(att.data.target.epoch))
-            try:
-                self.fork_choice.on_attestation(indexed, is_from_block=True)
-            except ForkChoiceError:
-                pass  # e.g. attestation for a block this store never saw
+        with span("fork_choice"):
+            self.fork_choice.on_tick(max(self.slot(), block.slot))
+            if execution_status is None:
+                execution_status = self._execution_status_of(block)
+            self.fork_choice.on_block(
+                block, block_root, state, execution_status=execution_status
+            )
+            monitoring = bool(self.validator_monitor.monitored)
+            for att in block.body.attestations:
+                indexed = get_indexed_attestation(state, att, t, preset, self.ctx.spec)
+                att_slot = int(att.data.slot)
+                if monitoring:
+                    # canonical-vote attribution against the importing state
+                    # (validator_monitor.rs register_attestation_in_block):
+                    # head = the chain's block root at the attestation's
+                    # slot, target = the root at its target epoch's start
+                    # slot. Skipped entirely when nothing is monitored —
+                    # this is the block-import hot path.
+                    head_hit = bytes(att.data.beacon_block_root) == bytes(
+                        get_block_root_at_slot(state, att_slot, preset)
+                    )
+                    target_start = int(att.data.target.epoch) * preset.slots_per_epoch
+                    target_hit = (
+                        int(state.slot) - target_start
+                        <= preset.slots_per_historical_root
+                        and bytes(att.data.target.root)
+                        == bytes(get_block_root_at_slot(state, target_start, preset))
+                    )
+                for vi in indexed.attesting_indices:
+                    if monitoring:
+                        self.validator_monitor.on_attestation_included(
+                            int(vi),
+                            att_slot,
+                            inclusion_delay=int(block.slot) - att_slot,
+                            head_hit=head_hit,
+                            target_hit=target_hit,
+                        )
+                    for obs in self.attestation_observers:
+                        obs(int(vi), int(att.data.target.epoch))
+                try:
+                    self.fork_choice.on_attestation(indexed, is_from_block=True)
+                except ForkChoiceError:
+                    pass  # e.g. attestation for a block this store never saw
+        self.validator_monitor.note_slot(int(block.slot))
 
     def _execution_status_of(self, block) -> str:
         """EL verdict for the block just imported: "irrelevant" for payload-
@@ -217,40 +260,50 @@ class BeaconChain:
         if parent_state is None:
             raise BlockError(f"unknown parent {parent_root.hex()[:16]}")
 
-        state = parent_state.copy()
-        all_sets = []
-        staged = []  # (root, signed_block, post_state)
-        prev_root = parent_root
+        from ..common.tracing import span
         from ..state_transition.per_block import BlockSignatureVerifier
 
-        for signed in blocks:
-            block = signed.message
-            if bytes(block.parent_root) != prev_root:
-                raise BlockError("segment is not parent-linked")
-            try:
-                process_slots(state, int(block.slot), self.ctx)
-                verifier = BlockSignatureVerifier(state, self.ctx)
-                verifier.include_all_signatures(signed)
-                all_sets.extend(verifier.sets)
-                per_block_processing(
-                    state, signed, self.ctx, strategy=BlockSignatureStrategy.NO_VERIFICATION
-                )
-            except StateTransitionError as e:
-                raise BlockError(str(e)) from e
-            root = type(block).hash_tree_root(block)
-            if bytes(block.state_root) != type(state).hash_tree_root(state):
-                raise BlockError("segment block state root mismatch")
-            # the engine verdict is per-block mutable state: capture it NOW
-            staged.append((root, signed, state.copy(), self._execution_status_of(block)))
-            prev_root = root
+        with span("chain_segment_import"):
+            state = parent_state.copy()
+            all_sets = []
+            staged = []  # (root, signed_block, post_state)
+            prev_root = parent_root
 
-        if all_sets and not self.ctx.bls.verify_signature_sets(all_sets):
-            raise BlockError("segment signature verification failed")
+            with span("state_transition"):
+                for signed in blocks:
+                    block = signed.message
+                    if bytes(block.parent_root) != prev_root:
+                        raise BlockError("segment is not parent-linked")
+                    try:
+                        process_slots(state, int(block.slot), self.ctx)
+                        verifier = BlockSignatureVerifier(state, self.ctx)
+                        verifier.include_all_signatures(signed)
+                        all_sets.extend(verifier.sets)
+                        per_block_processing(
+                            state,
+                            signed,
+                            self.ctx,
+                            strategy=BlockSignatureStrategy.NO_VERIFICATION,
+                        )
+                    except StateTransitionError as e:
+                        raise BlockError(str(e)) from e
+                    root = type(block).hash_tree_root(block)
+                    if bytes(block.state_root) != type(state).hash_tree_root(state):
+                        raise BlockError("segment block state root mismatch")
+                    # engine verdict is per-block mutable state: capture it NOW
+                    staged.append(
+                        (root, signed, state.copy(), self._execution_status_of(block))
+                    )
+                    prev_root = root
 
-        for root, signed, post_state, exec_status in staged:
-            self._post_import(root, signed, post_state, execution_status=exec_status)
-        self.recompute_head()
-        return [root for root, _, _, _ in staged]
+            with span("signature_verify"):
+                if all_sets and not self.ctx.bls.verify_signature_sets(all_sets):
+                    raise BlockError("segment signature verification failed")
+
+            for root, signed, post_state, exec_status in staged:
+                self._post_import(root, signed, post_state, execution_status=exec_status)
+            self.recompute_head()
+            return [root for root, _, _, _ in staged]
 
     def import_historical_block_batch(self, blocks) -> int:
         """Backfill: append blocks BEHIND the chain's oldest known block.

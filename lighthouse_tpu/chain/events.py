@@ -1,15 +1,15 @@
-"""Chain event bus + validator monitor.
+"""Chain event bus.
 
-Counterparts of /root/reference/beacon_node/beacon_chain/src/events.rs
-(the SSE feed http_api serves) and validator_monitor.rs (per-validator
-inclusion tracking for registered keys).
+Counterpart of /root/reference/beacon_node/beacon_chain/src/events.rs
+(the SSE feed http_api serves). The validator monitor that used to live
+here grew into chain/validator_monitor.py.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 
 @dataclass
@@ -46,30 +46,3 @@ class EventBus:
                 q.put_nowait(ev)
             except queue.Full:
                 pass  # slow consumer: drop, never block the chain
-
-
-class ValidatorMonitor:
-    """Tracks registered validators' participation (validator_monitor.rs:
-    per-epoch attestation inclusion + proposals for monitored keys)."""
-
-    def __init__(self):
-        self.monitored: set[int] = set()
-        self.attestations: dict[int, list[int]] = {}  # index -> slots seen
-        self.blocks: dict[int, list[int]] = {}
-
-    def register(self, validator_index: int) -> None:
-        self.monitored.add(validator_index)
-
-    def on_attestation_included(self, validator_index: int, slot: int) -> None:
-        if validator_index in self.monitored:
-            self.attestations.setdefault(validator_index, []).append(slot)
-
-    def on_block_proposed(self, validator_index: int, slot: int) -> None:
-        if validator_index in self.monitored:
-            self.blocks.setdefault(validator_index, []).append(slot)
-
-    def summary(self, validator_index: int) -> dict:
-        return {
-            "attestations": len(self.attestations.get(validator_index, [])),
-            "blocks": len(self.blocks.get(validator_index, [])),
-        }

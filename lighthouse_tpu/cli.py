@@ -116,9 +116,6 @@ def cmd_beacon_node(args) -> int:
 def cmd_validator_client(args) -> int:
     import urllib.request
 
-    # Keystore-based key loading (account-manager output) with an
-    # in-process fallback for interop keys.
-    from .crypto import keystore as ks
     from .crypto import bls as bls_pkg
 
     import contextlib
@@ -127,6 +124,10 @@ def cmd_validator_client(args) -> int:
     secret_keys = []
     with contextlib.ExitStack() as locks:
         if args.keystores:
+            # Keystore-based key loading (account-manager output) — imported
+            # lazily so interop-key runs work where the `cryptography`
+            # dependency is unavailable.
+            from .crypto import keystore as ks
             from .validator_client.lockfile import Lockfile
 
             password = args.password or ""
@@ -150,6 +151,7 @@ def cmd_validator_client(args) -> int:
         from .validator_client import (
             BeaconApiError,
             BeaconNodeHttpClient,
+            MetricsServer,
             ValidatorClient,
             ValidatorStore,
         )
@@ -163,6 +165,14 @@ def cmd_validator_client(args) -> int:
         for sk in secret_keys:
             store.add_validator(sk)
         vc = ValidatorClient(client, store)
+        metrics_server = None
+        if args.metrics_port is not None:
+            # the VC's own scrape surface (separate from any BN's /metrics)
+            metrics_server = MetricsServer(
+                vc=vc, host=args.metrics_address, port=args.metrics_port
+            ).start()
+            print(f"vc metrics listening on {args.metrics_address}:{metrics_server.port}")
+        locks.callback(lambda: metrics_server and metrics_server.stop())
 
         if args.run_slots is not None:
             start = int(client.syncing()["head_slot"])
@@ -424,6 +434,14 @@ def build_parser() -> argparse.ArgumentParser:
     vc.add_argument("--keystores", nargs="*")
     vc.add_argument("--password")
     vc.add_argument("--interop-validators", type=int, default=0)
+    vc.add_argument(
+        "--metrics-port", type=int,
+        help="serve the VC's own /metrics + /health on this port (0 = ephemeral)",
+    )
+    vc.add_argument(
+        "--metrics-address", default="127.0.0.1",
+        help="bind address for the VC metrics server (0.0.0.0 for remote scrapes)",
+    )
     vc.add_argument("--run-slots", type=int, help="run N duty slots then exit (testing)")
     vc.set_defaults(fn=cmd_validator_client)
 

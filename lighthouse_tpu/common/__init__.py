@@ -1,5 +1,23 @@
-"""Common utilities (SURVEY.md §2.5): metrics, logging glue."""
+"""Common utilities (SURVEY.md §2.5): metrics, tracing, logging glue."""
 
-from .metrics import REGISTRY, Counter, Gauge, Histogram, Registry
+from .metrics import (
+    REGISTRY,
+    Counter,
+    CounterVec,
+    Gauge,
+    GaugeVec,
+    Histogram,
+    HistogramVec,
+    Registry,
+)
 
-__all__ = ["REGISTRY", "Counter", "Gauge", "Histogram", "Registry"]
+__all__ = [
+    "REGISTRY",
+    "Counter",
+    "CounterVec",
+    "Gauge",
+    "GaugeVec",
+    "Histogram",
+    "HistogramVec",
+    "Registry",
+]

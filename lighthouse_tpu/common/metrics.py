@@ -4,6 +4,12 @@ Counterpart of /root/reference/common/lighthouse_metrics (src/lib.rs:1-18):
 a process-global registry of counters/gauges/histograms with timer helpers
 wrapping pipeline stages, and text exposition in the Prometheus format
 (served by http_metrics). No external dependency — exposition is a string.
+
+Labeled families (the reference's *_vec macros): `CounterVec` / `GaugeVec`
+/ `HistogramVec` hand out cached per-label-set children via `.labels(**kv)`
+and expose as ONE family — one HELP/TYPE header, one sample line per child
+with an escaped `{k="v",...}` label set (histogram children interleave `le`
+into theirs).
 """
 
 from __future__ import annotations
@@ -11,6 +17,15 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
+
+
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label-value escaping: backslash, quote, LF."""
+    return str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _label_str(pairs) -> str:
+    return ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in pairs)
 
 
 class Metric:
@@ -21,6 +36,8 @@ class Metric:
 
 
 class Counter(Metric):
+    typ = "counter"
+
     def __init__(self, name: str, help_text: str):
         super().__init__(name, help_text)
         self._value = 0.0
@@ -33,17 +50,19 @@ class Counter(Metric):
     def value(self) -> float:
         return self._value
 
-    def expose(self) -> str:
+    def samples(self) -> list:
+        """[(name_suffix, extra_label_pairs, value)] — the family exposition
+        unit shared by plain metrics and vec children."""
         with self._lock:
-            v = self._value
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} counter\n"
-            f"{self.name} {v}\n"
-        )
+            return [("", (), self._value)]
+
+    def expose(self) -> str:
+        return expose_family(self, [((), self)])
 
 
 class Gauge(Metric):
+    typ = "gauge"
+
     def __init__(self, name: str, help_text: str):
         super().__init__(name, help_text)
         self._value = 0.0
@@ -63,20 +82,20 @@ class Gauge(Metric):
     def value(self) -> float:
         return self._value
 
-    def expose(self) -> str:
+    def samples(self) -> list:
         with self._lock:
-            v = self._value
-        return (
-            f"# HELP {self.name} {self.help}\n"
-            f"# TYPE {self.name} gauge\n"
-            f"{self.name} {v}\n"
-        )
+            return [("", (), self._value)]
+
+    def expose(self) -> str:
+        return expose_family(self, [((), self)])
 
 
 DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 
 
 class Histogram(Metric):
+    typ = "histogram"
+
     def __init__(self, name: str, help_text: str, buckets=DEFAULT_BUCKETS):
         super().__init__(name, help_text)
         self.buckets = tuple(sorted(buckets))
@@ -110,23 +129,96 @@ class Histogram(Metric):
     def sum(self) -> float:
         return self._sum
 
-    def expose(self) -> str:
+    def samples(self) -> list:
+        """Bucket counts are cumulative WITHIN this metric (each vec child
+        carries its own cumulative `le` series, per the Prometheus format)."""
         with self._lock:
             counts = list(self._counts)
             total_sum, n = self._sum, self._n
-        lines = [
-            f"# HELP {self.name} {self.help}",
-            f"# TYPE {self.name} histogram",
-        ]
+        out = []
         cumulative = 0
         for b, c in zip(self.buckets, counts):
             cumulative += c
-            lines.append(f'{self.name}_bucket{{le="{b}"}} {cumulative}')
-        cumulative += counts[-1]
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cumulative}')
-        lines.append(f"{self.name}_sum {total_sum}")
-        lines.append(f"{self.name}_count {n}")
-        return "\n".join(lines) + "\n"
+            out.append(("_bucket", (("le", b),), cumulative))
+        out.append(("_bucket", (("le", "+Inf"),), cumulative + counts[-1]))
+        out.append(("_sum", (), total_sum))
+        out.append(("_count", (), n))
+        return out
+
+    def expose(self) -> str:
+        return expose_family(self, [((), self)])
+
+
+def expose_family(family, children) -> str:
+    """HELP/TYPE header + every child's samples. `children` is a list of
+    (label_pairs, metric) — plain metrics pass one unlabeled child (self)."""
+    lines = [
+        f"# HELP {family.name} {family.help}",
+        f"# TYPE {family.name} {family.typ}",
+    ]
+    for label_pairs, child in children:
+        for suffix, extra, value in child.samples():
+            labels = _label_str(tuple(label_pairs) + tuple(extra))
+            braces = f"{{{labels}}}" if labels else ""
+            lines.append(f"{family.name}{suffix}{braces} {value}")
+    return "\n".join(lines) + "\n"
+
+
+class MetricVec(Metric):
+    """A labeled family: `.labels(stage="h2c")` returns the cached child for
+    that label set, creating it on first use (prometheus's *Vec types /
+    lighthouse_metrics' try_create_*_vec + get_metric_with_label_values)."""
+
+    child_cls: type = Metric
+
+    def __init__(self, name: str, help_text: str, label_names, **child_kwargs):
+        super().__init__(name, help_text)
+        if not label_names:
+            raise ValueError(f"metric vec {name} needs at least one label name")
+        self.label_names = tuple(label_names)
+        self._child_kwargs = child_kwargs
+        self._children: dict[tuple, Metric] = {}
+
+    @property
+    def typ(self) -> str:
+        return self.child_cls.typ
+
+    def labels(self, **kv):
+        if set(kv) != set(self.label_names):
+            raise ValueError(
+                f"metric {self.name} takes labels {self.label_names}, got {tuple(kv)}"
+            )
+        key = tuple(str(kv[n]) for n in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self.child_cls(self.name, self.help, **self._child_kwargs)
+                self._children[key] = child
+            return child
+
+    def children(self) -> dict[tuple, Metric]:
+        """Snapshot of {label-values tuple: child} (introspection/reports)."""
+        with self._lock:
+            return dict(self._children)
+
+    def expose(self) -> str:
+        with self._lock:
+            kids = sorted(self._children.items())
+        return expose_family(
+            self, [(tuple(zip(self.label_names, key)), child) for key, child in kids]
+        )
+
+
+class CounterVec(MetricVec):
+    child_cls = Counter
+
+
+class GaugeVec(MetricVec):
+    child_cls = Gauge
+
+
+class HistogramVec(MetricVec):
+    child_cls = Histogram
 
 
 class Registry:
@@ -153,6 +245,31 @@ class Registry:
 
     def histogram(self, name: str, help_text: str = "", buckets=DEFAULT_BUCKETS) -> Histogram:
         return self._register(Histogram, name, help_text, buckets=buckets)
+
+    def _register_vec(self, cls, name, help_text, label_names, **kw):
+        vec = self._register(cls, name, help_text, label_names=label_names, **kw)
+        if vec.label_names != tuple(label_names):
+            raise ValueError(
+                f"metric {name} already registered with labels {vec.label_names}"
+            )
+        return vec
+
+    def counter_vec(self, name: str, help_text: str = "", label_names=()) -> CounterVec:
+        return self._register_vec(CounterVec, name, help_text, label_names)
+
+    def gauge_vec(self, name: str, help_text: str = "", label_names=()) -> GaugeVec:
+        return self._register_vec(GaugeVec, name, help_text, label_names)
+
+    def histogram_vec(
+        self, name: str, help_text: str = "", label_names=(), buckets=DEFAULT_BUCKETS
+    ) -> HistogramVec:
+        return self._register_vec(
+            HistogramVec, name, help_text, label_names, buckets=buckets
+        )
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
 
     def gather(self) -> str:
         """Prometheus text exposition of every registered metric."""
@@ -184,4 +301,29 @@ PROCESSOR_ITEMS_DROPPED = REGISTRY.counter(
 TASKS_FAILED_TOTAL = REGISTRY.counter(
     "lighthouse_tpu_tasks_failed_total",
     "Supervised tasks that died with an uncaught exception",
+)
+
+# Labeled pipeline families (this file owns the cross-cutting ones; stage
+# histograms fed by tracing spans live in common/tracing.py, validator
+# attribution in chain/validator_monitor.py).
+PROCESSOR_QUEUE_WAIT_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_processor_queue_wait_seconds",
+    "Time work items spent queued before a drain picked them up",
+    ("kind",),
+)
+PROCESSOR_HANDLE_SECONDS = REGISTRY.histogram_vec(
+    "lighthouse_tpu_processor_handle_seconds",
+    "Handler wall time per drained batch",
+    ("kind",),
+)
+BLS_JIT_BUILDS_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_bls_jit_builds_total",
+    "Device programs built per kernel family (cache-miss proxy: each build "
+    "is a new (S, K) bucket XLA will compile on first dispatch)",
+    ("kernel",),
+)
+BLS_BATCH_PADDED_SIZE = REGISTRY.histogram(
+    "lighthouse_tpu_bls_batch_padded_size",
+    "Padded set-count (S bucket) of each dispatched verify batch",
+    buckets=(4, 8, 16, 32, 64, 128, 256, 512),
 )

@@ -290,8 +290,11 @@ def _unpack_staged(flat, S: int, K: int):
 def _verify_kernel(S: int, K: int):
     """Build the jitted single-chip batch-verify program (flat-buffer
     calling convention; see _pack_staged)."""
+    from ....common.metrics import BLS_JIT_BUILDS_TOTAL
     from . import pairing
     from .tower import fp12_is_one
+
+    BLS_JIT_BUILDS_TOTAL.labels(kernel="verify").inc()
 
     def kernel(flat):
         pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits = _unpack_staged(flat, S, K)
@@ -316,11 +319,13 @@ def stage_sets(sets: list[SignatureSet], rng=None, s_floor: int = 4):
     (pow2, >= s_floor) with (generator-keyed, r=0) no-op sets and each key
     list to the K bucket with infinity points (additive identity). Returns
     (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits) numpy arrays."""
+    from ....common.metrics import BLS_BATCH_PADDED_SIZE
     from . import h2c
     from .pack import pack_g1_batch, pack_g2_batch
 
     S = _next_pow2(len(sets), floor=max(4, s_floor))
     K = _next_pow2(max(len(s.signing_keys) for s in sets))
+    BLS_BATCH_PADDED_SIZE.observe(S)
 
     pk_pts: list[Point] = []
     sig_pts: list[Point] = []
@@ -349,12 +354,16 @@ def stage_sets(sets: list[SignatureSet], rng=None, s_floor: int = 4):
             msgs.append(b"")
             # r stays 0: the padded set contributes the identity everywhere.
 
-    pk_x, pk_y, pk_inf = pack_g1_batch(pk_pts)
-    pk_x = pk_x.reshape(S, K, -1)
-    pk_y = pk_y.reshape(S, K, -1)
-    pk_inf = pk_inf.reshape(S, K)
-    sig_x, sig_y, sig_inf = pack_g2_batch(sig_pts)
-    u = h2c.hash_to_field_limbs(msgs)
+    from ....common.tracing import span
+
+    with span("bls_pack"):
+        pk_x, pk_y, pk_inf = pack_g1_batch(pk_pts)
+        pk_x = pk_x.reshape(S, K, -1)
+        pk_y = pk_y.reshape(S, K, -1)
+        pk_inf = pk_inf.reshape(S, K)
+        sig_x, sig_y, sig_inf = pack_g2_batch(sig_pts)
+    with span("bls_h2c_host"):
+        u = h2c.hash_to_field_limbs(msgs)
     return pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_rows
 
 
@@ -402,13 +411,17 @@ def verify_signature_sets(sets: list[SignatureSet], rng=None) -> bool:
     one final exponentiation. Returns False (never raises) for structurally
     invalid batches, like the reference."""
     from ....common.metrics import BLS_BATCH_SECONDS, BLS_SETS_TOTAL
+    from ....common.tracing import span
 
     if not _structurally_valid(sets):
         return False  # structurally invalid: no device work, no metrics
     # the timer spans staging + dispatch + fetch (the full batch cost, as
-    # the dashboards expect)
-    with BLS_BATCH_SECONDS.time():
-        ok = verify_signature_sets_async(sets, rng=rng).result()
+    # the dashboards expect); staging's bls_pack/bls_h2c_host spans nest
+    # under this root, the remainder is device execute + fetch
+    with BLS_BATCH_SECONDS.time(), span("bls_batch_verify"):
+        fut = verify_signature_sets_async(sets, rng=rng)
+        with span("bls_device_execute"):
+            ok = fut.result()
     BLS_SETS_TOTAL.inc(len(sets))
     return ok
 
@@ -418,7 +431,10 @@ def verify_signature_sets(sets: list[SignatureSet], rng=None) -> bool:
 
 @lru_cache(maxsize=8)
 def _pk_validate_kernel(S: int):
+    from ....common.metrics import BLS_JIT_BUILDS_TOTAL
     from .curve import FP, from_affine, g1_in_subgroup
+
+    BLS_JIT_BUILDS_TOTAL.labels(kernel="pk_validate").inc()
 
     def kernel(x, y, inf):
         return g1_in_subgroup(from_affine(FP, x, y, inf)) & ~inf

@@ -18,6 +18,7 @@ introspection:
   GET  /eth/v1/validator/attestation_data?slot=&committee_index=
   GET  /eth/v2/validator/blocks/{slot}?randao_reveal=
   GET  /metrics        (Prometheus text; http_metrics' scrape surface)
+  GET  /lighthouse/ui/validator_metrics   (ValidatorMonitor attribution)
 """
 
 from __future__ import annotations
@@ -88,7 +89,11 @@ class _Handler(BaseHTTPRequestHandler):
         if block_id == "genesis":
             return chain.genesis_block_root
         if block_id == "finalized":
-            return bytes(chain.fork_choice.finalized_checkpoint.root)
+            root = bytes(chain.fork_choice.finalized_checkpoint.root)
+            # pre-finalization the checkpoint root is ZERO; the Beacon API
+            # convention resolves that to genesis (otherwise the headers
+            # route would serve the genesis header labeled 0x00…00)
+            return root if root != b"\x00" * 32 else chain.genesis_block_root
         root = _parse_root(block_id, "block")
         if chain.store.get_block(root) is None and root != chain.genesis_block_root:
             raise ApiError(404, "block not found")
@@ -151,6 +156,10 @@ class _Handler(BaseHTTPRequestHandler):
         t = ctx.types
         if parts == ["metrics"]:
             self._send(200, REGISTRY.gather().encode(), "text/plain; version=0.0.4")
+        elif parts == ["lighthouse", "ui", "validator_metrics"]:
+            # per-validator attribution for registered keys (the reference's
+            # /lighthouse/ui/validator_metrics UI endpoint)
+            self._send(200, _data(chain.validator_monitor.ui_payload()))
         elif parts == ["eth", "v1", "node", "health"]:
             self._send(200, b"")
         elif parts == ["eth", "v1", "node", "version"]:

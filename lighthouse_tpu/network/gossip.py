@@ -279,8 +279,18 @@ class GossipNode:
                 self._drop_peer(source)  # known-banned identity
                 return
         for topic in ctrl.get("graft", []):
-            # a graylisted peer's GRAFT is answered with PRUNE (v1.1 score gate)
-            if self.peer_db.is_usable(self._peer_id(source)):
+            # GRAFT is refused with PRUNE when the peer is graylisted (v1.1
+            # score gate) OR the mesh is already at D_HIGH — admitting past
+            # the bound and trimming at the next heartbeat leaves windows
+            # where the mesh exceeds its contract (gossipsub spec: a full
+            # mesh answers GRAFT with PRUNE immediately). The mesh entry is
+            # created only on actual admission, so refused GRAFTs (e.g. a
+            # graylisted peer spamming random topic names) cannot mint
+            # unbounded empty mesh entries.
+            mesh = self._mesh.get(str(topic), ())
+            if self.peer_db.is_usable(self._peer_id(source)) and (
+                source in mesh or len(mesh) < self.d_high
+            ):
                 self._mesh.setdefault(str(topic), set()).add(source)
             else:
                 self._send(source, encode_control({"prune": [topic]}))

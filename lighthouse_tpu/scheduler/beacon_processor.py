@@ -22,6 +22,7 @@ reference (mod.rs LifoQueue/FifoQueue).
 from __future__ import annotations
 
 import enum
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -78,18 +79,25 @@ class BeaconProcessor:
         if bounds:
             self.bounds.update(bounds)
         self.queues: dict[WorkType, deque] = {wt: deque() for wt in WorkType}
+        # enqueue timestamps, shadowing self.queues op-for-op (append ↔
+        # append, pop ↔ pop, popleft ↔ popleft) so drains can attribute
+        # queue-wait per work kind without wrapping the items themselves
+        # (handlers and tests see raw items)
+        self._enqueued_at: dict[WorkType, deque] = {wt: deque() for wt in WorkType}
         self.stats = ProcessorStats()
 
     def submit(self, work_type: WorkType, item) -> bool:
         """Enqueue; returns False when the bounded queue drops the item
         (drop-on-overflow, mod.rs FifoQueue/LifoQueue push)."""
         q = self.queues[work_type]
+        ts = self._enqueued_at[work_type]
         if len(q) >= self.bounds[work_type]:
             # FIFO queues drop the NEW item; LIFO queues drop the OLDEST
             # (freshest-first semantics for attestations).
             if work_type in _LIFO_TYPES:
                 try:
                     q.popleft()
+                    ts.popleft()
                 except IndexError:
                     pass  # a concurrent drain already emptied the queue
                 self.stats.bump(self.stats.dropped, work_type)
@@ -97,6 +105,7 @@ class BeaconProcessor:
                 self.stats.bump(self.stats.dropped, work_type)
                 return False
         q.append(item)
+        ts.append(time.monotonic())
         self.stats.bump(self.stats.submitted, work_type)
         from ..common.metrics import PROCESSOR_QUEUE_DEPTH
 
@@ -115,6 +124,9 @@ class BeaconProcessor:
             q = self.queues[wt]
             if not q:
                 continue
+            now = time.monotonic()
+            ts = self._enqueued_at[wt]
+            waits = []
             if wt in _LIFO_TYPES:
                 cap = (
                     MAX_GOSSIP_ATTESTATION_BATCH_SIZE
@@ -122,12 +134,31 @@ class BeaconProcessor:
                     else MAX_GOSSIP_AGGREGATE_BATCH_SIZE
                 )
                 items = [q.pop() for _ in range(min(cap, len(q)))]  # LIFO
+                for _ in items:
+                    # a concurrent submit-overflow popleft can shrink ts
+                    # under us (same race submit guards on q): stop rather
+                    # than crash the drain; the shadow deque re-aligns as
+                    # both sides keep mirroring operations
+                    try:
+                        waits.append(now - ts.pop())
+                    except IndexError:
+                        break
             else:
                 items = [q.popleft()]
+                try:
+                    waits.append(now - ts.popleft())
+                except IndexError:
+                    pass
             self.stats.bump(self.stats.drained, wt, len(items))
-            from ..common.metrics import PROCESSOR_QUEUE_DEPTH
+            from ..common.metrics import (
+                PROCESSOR_QUEUE_DEPTH,
+                PROCESSOR_QUEUE_WAIT_SECONDS,
+            )
 
             PROCESSOR_QUEUE_DEPTH.set(len(self))
+            wait_hist = PROCESSOR_QUEUE_WAIT_SECONDS.labels(kind=wt.name.lower())
+            for w in waits:
+                wait_hist.observe(max(0.0, w))
             return Batch(work_type=wt, items=items)
         return None
 
@@ -159,11 +190,18 @@ class BeaconProcessor:
         missing = [wt for wt, q in self.queues.items() if q and wt not in handlers]
         if missing:
             raise KeyError(f"no handler for queued work types {missing!r}")
+        from ..common.metrics import PROCESSOR_HANDLE_SECONDS
+        from ..common.tracing import span
+
         n = 0
         while max_batches is None or n < max_batches:
             batch = self.next_batch()
             if batch is None:
                 break
-            handlers[batch.work_type](batch.items)
+            kind = batch.work_type.name.lower()
+            with PROCESSOR_HANDLE_SECONDS.labels(kind=kind).time(), span(
+                f"processor_handle_{kind}"
+            ):
+                handlers[batch.work_type](batch.items)
             n += 1
         return n

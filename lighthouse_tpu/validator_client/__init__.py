@@ -3,6 +3,7 @@ attestation/block services over the beacon-node API seam.
 """
 
 from .http_client import BeaconApiError, BeaconNodeHttpClient
+from .metrics_server import MetricsServer
 from .slashing_protection import SlashingDatabase, SlashingProtectionError
 from .validator_client import (
     AttesterDuty,
@@ -14,6 +15,7 @@ from .validator_client import (
 __all__ = [
     "BeaconApiError",
     "BeaconNodeHttpClient",
+    "MetricsServer",
     "SlashingDatabase",
     "SlashingProtectionError",
     "AttesterDuty",

@@ -17,6 +17,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..chain.attestation_processing import batch_verify_gossip_attestations
+from ..common.metrics import REGISTRY
 from ..op_pool import OperationPool
 from ..ssz.types import uint64
 from ..state_transition.helpers import (
@@ -34,6 +35,15 @@ from ..types import (
 )
 from ..types.containers import Checkpoint, SigningData
 from .slashing_protection import SlashingDatabase, SlashingProtectionError
+
+
+# successful duty publications per type — the VC's own /metrics headline
+# (http_metrics' SIGNED_* counters in the reference VC)
+VC_DUTIES_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_vc_duties_total",
+    "Duties this validator client completed, by duty type",
+    ("duty",),
+)
 
 
 @dataclass
@@ -579,6 +589,9 @@ class ValidatorClient:
         self.doppelganger = doppelganger  # None -> protection disabled
         self._duty_cache: dict[int, list[AttesterDuty]] = {}
         self._proposer_cache: dict[int, dict[int, int]] = {}
+        # the /health surface (metrics_server.MetricsServer)
+        self.last_duty_slot: int | None = None
+        self.duty_totals: dict[str, int] = {}
         if doppelganger is not None:
             # liveness feed: every attestation the BN sees (blocks + gossip)
             api.chain.attestation_observers.append(self._observe_attestation)
@@ -766,4 +779,11 @@ class ValidatorClient:
                 )
                 if self.api.publish_contribution(signed):
                     summary["contributions"] += 1
+
+        self.last_duty_slot = slot
+        for duty, count in summary.items():
+            n = int(count is not None) if duty == "proposed" else int(count)
+            if n:
+                self.duty_totals[duty] = self.duty_totals.get(duty, 0) + n
+                VC_DUTIES_TOTAL.labels(duty=duty).inc(n)
         return summary

@@ -32,12 +32,19 @@ N_SETS = int(os.environ.get("PROFILE_N_SETS", "128"))
 REPS = int(os.environ.get("PROFILE_REPS", "5"))
 
 
-def med(fn, reps=REPS):
-    fn()  # warm (compile)
+def med(fn, label, reps=REPS):
+    """Median of `reps` timed calls, each also recorded as a tracing span
+    `label` — so the tracer/metrics breakdown printed at the end reports the
+    SAME measurements as the medians below (bench rounds and the Prometheus
+    scrape can no longer disagree about per-stage cost)."""
+    from lighthouse_tpu.common.tracing import span
+
+    fn()  # warm (compile) — deliberately NOT recorded as a span
     ts = []
     for _ in range(reps):
         t0 = time.perf_counter()
-        fn()
+        with span(label):
+            fn()
         ts.append(time.perf_counter() - t0)
     return statistics.median(ts)
 
@@ -87,7 +94,7 @@ def main() -> None:
 
     # -- stage 1: hash to G2 ---------------------------------------------------
     h2g = jax.jit(lambda uu: h2c.hash_to_g2_device(uu))
-    t_h2c = med(lambda: jax.block_until_ready(h2g(u)))
+    t_h2c = med(lambda: jax.block_until_ready(h2g(u)), "bls_h2c")
     print(f"stage h2c                 {t_h2c * 1e3:9.2f} ms", flush=True)
     H = h2g(u)
 
@@ -115,7 +122,8 @@ def main() -> None:
 
     lad = jax.jit(ladders)
     t_lad = med(
-        lambda: jax.block_until_ready(lad(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, r_bits))
+        lambda: jax.block_until_ready(lad(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, r_bits)),
+        "bls_ladders",
     )
     print(f"stage ladders+folds       {t_lad * 1e3:9.2f} ms", flush=True)
     r_pk, sig_acc, sub_ok, agg_inf = lad(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, r_bits)
@@ -135,24 +143,39 @@ def main() -> None:
         return pairing.product_reduce(f)
 
     mil = jax.jit(miller)
-    t_mil = med(lambda: jax.block_until_ready(mil(r_pk, H, sig_acc)))
+    t_mil = med(lambda: jax.block_until_ready(mil(r_pk, H, sig_acc)), "bls_miller")
     print(f"stage affine+miller+tree  {t_mil * 1e3:9.2f} ms", flush=True)
     partial = mil(r_pk, H, sig_acc)
 
     # -- stage 4: final exponentiation ----------------------------------------
     fe = jax.jit(pairing.final_exponentiation)
-    t_fe = med(lambda: jax.block_until_ready(fe(partial)))
+    t_fe = med(lambda: jax.block_until_ready(fe(partial)), "bls_final_exp")
     print(f"stage final_exp           {t_fe * 1e3:9.2f} ms", flush=True)
 
     # -- full single-program kernel -------------------------------------------
     flat = jnp.asarray(japi._pack_staged(staged))
     kernel = japi._verify_kernel(S, K)
-    t_full = med(lambda: jax.block_until_ready(kernel(flat)))
+    t_full = med(lambda: jax.block_until_ready(kernel(flat)), "bls_full_kernel")
     print(f"full fused kernel         {t_full * 1e3:9.2f} ms", flush=True)
     print(
         f"sum of stages             {(t_h2c + t_lad + t_mil + t_fe) * 1e3:9.2f} ms",
         flush=True,
     )
+
+    # -- span-derived breakdown ------------------------------------------------
+    # the same numbers the tracer feeds lighthouse_tpu_stage_seconds{stage=}
+    # (stage_sets' host-side bls_pack/bls_h2c_host spans appear too), so a
+    # bench round and a /metrics scrape attribute identically
+    from lighthouse_tpu.common.tracing import TRACER
+
+    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
+    for stage, rec in TRACER.stage_report().items():
+        print(
+            f"  {stage:22s} n={rec['count']:3d}"
+            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
+            f"  total={rec['total_s'] * 1e3:9.2f} ms",
+            flush=True,
+        )
 
 
 if __name__ == "__main__":

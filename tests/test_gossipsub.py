@@ -117,9 +117,12 @@ def test_graylisted_graft_gets_pruned():
         pid = a._peer_id(peer_sock)
         a.peer_db.penalize(pid, -GRAYLIST_THRESHOLD + 1)  # push below graylist
         assert not a.peer_db.is_usable(pid)
-        # a graft from that peer is rejected (not added to mesh)
-        a._on_control(encode_control({"graft": ["topic-x"]}), peer_sock)
-        assert peer_sock not in a._mesh.get("topic-x", set())
+        # a graft from that peer is rejected (not added to mesh), and the
+        # refusal must not mint a mesh entry for the attacker-chosen topic
+        for i in range(8):
+            a._on_control(encode_control({"graft": [f"topic-{i}"]}), peer_sock)
+        assert peer_sock not in a._mesh.get("topic-0", set())
+        assert not any(t.startswith("topic-") for t in a._mesh)
     finally:
         _close(nodes)
 

@@ -1,0 +1,42 @@
+"""Metric-name lint: everything registered on the process-global registry
+must be `lighthouse_tpu_`-prefixed snake_case, so scrapes stay collision-
+free next to other exporters and dashboards can glob one prefix.
+
+Imports every module that registers metrics at import time, then audits
+the registry — a new module registering `my_counter` fails here, not in
+production Grafana.
+"""
+
+import re
+
+NAME_RE = re.compile(r"^lighthouse_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+
+
+def test_registered_metric_names_are_prefixed_snake_case():
+    # modules that register on REGISTRY at import time
+    import lighthouse_tpu.chain.validator_monitor  # noqa: F401
+    import lighthouse_tpu.common.metrics  # noqa: F401
+    import lighthouse_tpu.common.tracing  # noqa: F401
+    import lighthouse_tpu.validator_client.validator_client  # noqa: F401
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    names = REGISTRY.names()
+    assert names, "the global registry should not be empty"
+    bad = [n for n in names if not NAME_RE.fullmatch(n)]
+    assert not bad, f"metric names violating the lighthouse_tpu_ snake_case convention: {bad}"
+
+
+def test_histogram_families_use_unit_suffixes():
+    """Histograms carry a unit suffix (_seconds/_slots/_size/_bytes) — the
+    Prometheus naming convention the dashboards assume."""
+    from lighthouse_tpu.common.metrics import REGISTRY, Histogram, HistogramVec
+
+    with REGISTRY._lock:
+        hists = [
+            n
+            for n, m in REGISTRY._metrics.items()
+            if isinstance(m, (Histogram, HistogramVec))
+        ]
+    allowed = ("_seconds", "_slots", "_size", "_bytes")
+    bad = [n for n in hists if not n.endswith(allowed)]
+    assert not bad, f"histograms missing a unit suffix: {bad}"

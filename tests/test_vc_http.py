@@ -109,3 +109,22 @@ def test_vc_binary_runs_duties_over_http(bn):
     )
     assert rc == 0
     assert int(chain.head_state().slot) >= 2, "blocks proposed over the wire"
+
+
+def test_vc_binary_starts_its_own_metrics_server(bn, capsys):
+    """--metrics-port gives the VC binary its own /metrics + /health server
+    (stopped with the client; the serving surface itself is covered by
+    tests/test_observability.py)."""
+    from lighthouse_tpu.cli import main
+
+    ctx, chain, server = bn
+    chain.slot_clock.set_slot(8)
+    rc = main(
+        [
+            "validator-client", "--preset", "minimal", "--bls-backend", "fake",
+            "--beacon-node", f"http://127.0.0.1:{server.port}",
+            "--interop-validators", "4", "--metrics-port", "0", "--run-slots", "1",
+        ]
+    )
+    assert rc == 0
+    assert "vc metrics listening on 127.0.0.1:" in capsys.readouterr().out
