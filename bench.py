@@ -165,6 +165,72 @@ def bench_config5(b):
     }
 
 
+def bench_coalesce(b):
+    """#6: cross-caller coalescing — 64 concurrent single-set callers
+    (the gossip arrival pattern: every set reaches the verifier alone),
+    sets/sec WITH the BatchVerifier service vs WITHOUT (each caller paying
+    the S=4 padding floor + per-dispatch fixed cost)."""
+    import threading
+
+    from lighthouse_tpu.crypto.bls.batch_verifier import BatchVerifier
+
+    n_callers, rounds = 64, 2
+    sets = _tiled_sets(b, n_callers)
+
+    def run_without():
+        oks = []
+        threads = []
+
+        def caller(s):
+            oks.append(all(b.verify_signature_sets([s]) for _ in range(rounds)))
+
+        for s in sets:
+            threads.append(threading.Thread(target=caller, args=(s,)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return all(oks)
+
+    svc = BatchVerifier(b).start()
+
+    def run_with():
+        oks = []
+        threads = []
+
+        def caller(s):
+            oks.append(
+                all(svc.submit([s]).result(timeout=600.0)[0] for _ in range(rounds))
+            )
+
+        for s in sets:
+            threads.append(threading.Thread(target=caller, args=(s,)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return all(oks)
+
+    try:
+        sec_without = _timed(run_without, reps=3)
+        sec_with = _timed(run_with, reps=3)
+        # one extra measured rep with warm kernels for an exact batch count
+        d0 = svc.dispatches
+        assert run_with()
+        dispatches = svc.dispatches - d0
+    finally:
+        svc.stop()
+    total = n_callers * rounds
+    return {
+        "metric": "coalesced_64caller_throughput",
+        "value": round(total / sec_with, 2),
+        "unit": "sets_per_sec",
+        "uncoalesced_sets_per_sec": round(total / sec_without, 2),
+        "speedup": round(sec_without / sec_with, 2),
+        "device_batches_warm_rep": dispatches,  # vs `total` uncoalesced
+    }
+
+
 def bench_epoch_processing():
     """Host-side half of config #5: the epoch-boundary transition at a
     large validator count (SURVEY.md §7 hard part 4 — the reference runs
@@ -252,6 +318,7 @@ def child_main() -> None:
         results["config3"] = bench_config3(b)
         results["config4"] = bench_config4(b)
         results["config5"] = bench_config5(b)
+        results["coalesce"] = bench_coalesce(b)
         results["epoch_processing"] = bench_epoch_processing()
         results["cpu_oracle"] = bench_cpu_oracle()
     headline = bench_config2(b)

@@ -12,6 +12,7 @@ exists to feed (SURVEY.md §2.8 items 1 & 3).
 
 from __future__ import annotations
 
+from ..crypto.bls.batch_verifier import active_for, verify_sets
 from ..state_transition import signature_sets as sigsets
 from ..state_transition.helpers import (
     StateTransitionError,
@@ -59,22 +60,13 @@ def _stage_gossip_attestations(chain, attestations):
     return results, staged
 
 
-def _resolve_and_apply(chain, results, staged, batch_ok, apply_to_fork_choice):
-    """Fill `results` from the batch verdict (with the per-set poisoning
-    fallback of batch.rs:203-219), then observe + fork-choice the accepted
+def _resolve_and_apply(chain, results, staged, set_verdicts, apply_to_fork_choice):
+    """Fill `results` from the per-set verdicts (produced by the coalescer's
+    bisection blame, or by the one-batch + per-set poisoning fallback of
+    batch.rs:203-219), then observe + fork-choice the accepted
     attestations."""
-    ctx = chain.ctx
-    if staged:
-        if batch_ok:
-            for i, _, _ in staged:
-                results[i] = True
-        else:
-            for i, _, s in staged:
-                results[i] = (
-                    True
-                    if ctx.bls.verify_signature_sets([s])
-                    else AttestationError("invalid signature")
-                )
+    for (i, _, _), ok in zip(staged, set_verdicts):
+        results[i] = True if ok else AttestationError("invalid signature")
 
     for i, indexed, _ in staged:
         if results[i] is True:
@@ -102,10 +94,8 @@ def batch_verify_gossip_attestations(chain, attestations, apply_to_fork_choice: 
 
     with span("gossip_attestation_verify"):
         results, staged = _stage_gossip_attestations(chain, attestations)
-        batch_ok = bool(staged) and chain.ctx.bls.verify_signature_sets(
-            [s for _, _, s in staged]
-        )
-        return _resolve_and_apply(chain, results, staged, batch_ok, apply_to_fork_choice)
+        verdicts = verify_sets(chain.ctx.bls, [s for _, _, s in staged])
+        return _resolve_and_apply(chain, results, staged, verdicts, apply_to_fork_choice)
 
 
 class PipelinedGossipVerifier:
@@ -147,13 +137,32 @@ class PipelinedGossipVerifier:
         staged = kept
         future = None
         if staged:
-            submit_async = getattr(self.chain.ctx.bls, "verify_signature_sets_async", None)
+            bls = self.chain.ctx.bls
             sets = [s for _, _, s in staged]
-            if submit_async is not None:
+            svc = active_for(bls)
+            submit_async = getattr(bls, "verify_signature_sets_async", None)
+            if svc is not None:
+                # cross-caller coalescing: the batch shares a device
+                # dispatch with whatever else is in flight, and a failed
+                # shared batch bisects to per-set verdicts
+                future = svc.submit(sets)
+            elif submit_async is not None:
                 future = submit_async(sets)
             else:
-                future = _SyncVerdict(self.chain.ctx.bls.verify_signature_sets(sets))
+                future = _SyncVerdict(bls.verify_signature_sets(sets))
         self._pending.append((list(attestations), results, staged, future))
+
+    def _verdicts(self, staged, future) -> list:
+        """Normalize a batch future into per-set verdicts: BatchFuture
+        resolves to a verdict list already; a bool verdict expands to
+        all-True or falls back to per-set verification (batch.rs:203)."""
+        raw = future.result() if future is not None else []
+        if isinstance(raw, (list, tuple)):
+            return list(raw)
+        if raw:
+            return [True] * len(staged)
+        bls = self.chain.ctx.bls
+        return [bool(bls.verify_signature_sets([s])) for _, _, s in staged]
 
     def flush(self, route) -> None:
         """`route(att, result)` is called for every submitted attestation,
@@ -164,9 +173,12 @@ class PipelinedGossipVerifier:
         self._provisional.clear()
         for items, results, staged, future in pending:
             try:
-                batch_ok = bool(future.result()) if future is not None else False
                 _resolve_and_apply(
-                    self.chain, results, staged, batch_ok, self.apply_to_fork_choice
+                    self.chain,
+                    results,
+                    staged,
+                    self._verdicts(staged, future),
+                    self.apply_to_fork_choice,
                 )
             except Exception:  # noqa: BLE001 — hostile-input boundary
                 from ..common.metrics import PROCESSOR_ITEMS_DROPPED
@@ -312,8 +324,20 @@ def _batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: boo
             results[i] = e
 
     if staged:
-        all_sets = [s for _, _, _, sets, _ in staged for s in sets]
-        if ctx.bls.verify_signature_sets(all_sets):
+        svc = active_for(ctx.bls)
+        if svc is not None:
+            # coalesced: one verdict per individual set (bisection blame);
+            # an aggregate is admitted iff all three of its sets verify
+            all_sets = [s for _, _, _, sets, _ in staged for s in sets]
+            verdicts = svc.submit(all_sets).result()
+            pos = 0
+            for i, _, _, sets, _ in staged:
+                ok = all(verdicts[pos : pos + len(sets)])
+                pos += len(sets)
+                results[i] = True if ok else AttestationError("invalid signature")
+        elif ctx.bls.verify_signature_sets(
+            [s for _, _, _, sets, _ in staged for s in sets]
+        ):
             for i, _, _, _, _ in staged:
                 results[i] = True
         else:

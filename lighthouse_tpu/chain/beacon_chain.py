@@ -99,6 +99,13 @@ class BeaconChain:
         SignedBeaconBlock (backfill_sync/mod.rs stops at genesis)."""
         return self.oldest_block_slot <= 1 or self._anchor_parent_root == b"\x00" * 32
 
+    @property
+    def backfill_parent_root(self) -> bytes:
+        """Root of the block the backfill frontier needs next (the oldest
+        known block's parent) — BackFillSync uses it to tell a bad batch
+        from a span that simply ends below the frontier's parent."""
+        return self._anchor_parent_root
+
     # -- queries ---------------------------------------------------------------
 
     def head_state(self):

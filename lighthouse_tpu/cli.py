@@ -42,18 +42,11 @@ def cmd_beacon_node(args) -> int:
         gpath = pathlib.Path(args.testnet_dir) / "genesis.ssz"
         if gpath.exists():
             genesis_state_path = str(gpath)
-        from .networks import load_config_yaml, network_config
-        from .types import MAINNET_SPEC, MINIMAL_SPEC
+        # shared resolution with the validator client (_vc_ctx): a named
+        # network supplies the base spec, config.yaml overrides on top
+        from .networks import resolve_spec
 
-        if args.network is not None:
-            # base the override on the NETWORK's spec, not --preset's
-            # default (mixing the two yields a mismatched pair)
-            _, base = network_config(args.network)
-        else:
-            base = MINIMAL_SPEC if args.preset == "minimal" else MAINNET_SPEC
-        spec_override = load_config_yaml(
-            pathlib.Path(args.testnet_dir) / "config.yaml", base=base
-        )
+        _, spec_override = resolve_spec(args.preset, args.network, args.testnet_dir)
     cfg = ClientConfig(
         preset=args.preset,
         network=args.network,
@@ -156,7 +149,7 @@ def cmd_validator_client(args) -> int:
             ValidatorStore,
         )
 
-        ctx = _ctx_for(args)
+        ctx = _vc_ctx(args)
         client = BeaconNodeHttpClient(urls, ctx)
         genesis = client.genesis()
         genesis_time = int(genesis["genesis_time"])
@@ -210,6 +203,28 @@ def _ctx_for(args):
         if args.preset == "minimal"
         else TransitionContext.mainnet(args.bls_backend)
     )
+
+
+def _vc_ctx(args):
+    """The validator-client's context, honoring --network/--testnet-dir
+    through the SAME networks.resolve_spec the beacon node uses: the VC
+    must sign duties in the fork domains the testnet's beacon nodes
+    expect (an lcli-generated testnet moves fork epochs via config.yaml;
+    signing against the preset default spec produces wrong-domain
+    signatures the BN rejects). NOT shared with lcli, whose new-testnet
+    --testnet-dir is an OUTPUT path."""
+    from .networks import resolve_spec
+    from .state_transition import TransitionContext
+
+    preset_name, spec = resolve_spec(args.preset, args.network, args.testnet_dir)
+    ctx = (
+        TransitionContext.minimal(args.bls_backend)
+        if preset_name == "minimal"
+        else TransitionContext.mainnet(args.bls_backend)
+    )
+    if spec is not None:
+        ctx.spec = spec
+    return ctx
 
 
 def cmd_account_manager(args) -> int:
@@ -427,6 +442,16 @@ def build_parser() -> argparse.ArgumentParser:
 
     vc = sub.add_parser("validator-client", help="run a validator client")
     _add_common(vc)
+    vc.add_argument(
+        "--network",
+        choices=sorted(NETWORKS),
+        help="named network config (duty signatures use its fork domains)",
+    )
+    vc.add_argument(
+        "--testnet-dir",
+        help="directory with a config.yaml spec override (lcli new-testnet "
+        "output) — required for correct duty-signature domains on testnets",
+    )
     vc.add_argument(
         "--beacon-node", dest="beacon_nodes", action="append", default=[],
         help="beacon node URL (repeatable: health-ordered fallback)",

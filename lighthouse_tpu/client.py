@@ -52,6 +52,11 @@ class ClientConfig:
     # explicit genesis state (a testnet dir's genesis.ssz): overrides the
     # interop genesis when booting fresh
     genesis_state_path: str | None = None
+    # cross-caller BLS batch coalescing (crypto/bls/batch_verifier.py).
+    # None = auto: enabled iff the backend exposes an async dispatch path
+    # (the jax backend) — the ref/fake backends gain nothing from
+    # coalescing and keep their synchronous behavior.
+    coalesce_bls: bool | None = None
 
 
 class Client:
@@ -116,7 +121,18 @@ class Client:
             self._replay_fork_choice(store)
         self.op_pool = OperationPool(ctx)
         self.api = BeaconNodeApi(self.chain, op_pool=self.op_pool)
-        self.processor = BeaconProcessor()
+        # cross-caller batch coalescing: gossip attestation / aggregate /
+        # sync-message verifications share device batches (blocks keep
+        # their dedicated per-block batch)
+        self.coalescer = None
+        coalesce = config.coalesce_bls
+        if coalesce is None:
+            coalesce = hasattr(ctx.bls, "verify_signature_sets_async")
+        if coalesce:
+            from .crypto.bls.batch_verifier import ensure_running
+
+            self.coalescer = ensure_running(ctx.bls)
+        self.processor = BeaconProcessor(coalescer=self.coalescer)
         self.slasher = Slasher(ctx) if config.slasher_enabled else None
         self.http: HttpApiServer | None = None
         if config.http_enabled:
@@ -234,6 +250,11 @@ class Client:
     def shutdown(self) -> None:
         """Clean shutdown: persist chain head (Drop for BeaconChain,
         beacon_chain.rs:4590), stop servers."""
+        if self.coalescer is not None:
+            from .crypto.bls.batch_verifier import release
+
+            release(self.coalescer)
+            self.coalescer = None
         store = self.chain.store
         if isinstance(store, HotColdDB):
             store.persist_head(self.chain.head_root, self.chain.genesis_block_root)

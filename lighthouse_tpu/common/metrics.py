@@ -302,6 +302,11 @@ TASKS_FAILED_TOTAL = REGISTRY.counter(
     "lighthouse_tpu_tasks_failed_total",
     "Supervised tasks that died with an uncaught exception",
 )
+GOSSIP_INTERNAL_ERRORS_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_gossip_internal_errors_total",
+    "Frames dropped because OUR handler raised (not the peer's fault: the "
+    "link is kept; a climbing rate means a local bug, not a bad peer)",
+)
 
 # Labeled pipeline families (this file owns the cross-cutting ones; stage
 # histograms fed by tracing spans live in common/tracing.py, validator
@@ -326,4 +331,35 @@ BLS_BATCH_PADDED_SIZE = REGISTRY.histogram(
     "lighthouse_tpu_bls_batch_padded_size",
     "Padded set-count (S bucket) of each dispatched verify batch",
     buckets=(4, 8, 16, 32, 64, 128, 256, 512),
+)
+
+# Cross-caller batch coalescing (crypto/bls/batch_verifier.py): the
+# BatchVerifier service merges concurrent single-set callers into shared
+# device batches and bisects failed batches down to the guilty sets.
+BLS_COALESCED_BATCH_SIZE = REGISTRY.histogram(
+    "lighthouse_tpu_bls_coalesced_batch_size",
+    "Signature sets per coalesced device dispatch (pre-padding: full "
+    "buckets mean the coalescer is beating the S=4 padding floor)",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512),
+)
+BLS_COALESCE_WAIT_SECONDS = REGISTRY.histogram(
+    "lighthouse_tpu_bls_coalesce_wait_seconds",
+    "Time a submission waited in the coalescer before its batch dispatched",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0),
+)
+BLS_COALESCED_DISPATCHES_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_bls_coalesced_dispatches_total",
+    "Device batches dispatched by the coalescer (vs one per caller without it)",
+)
+BLS_BISECTION_BATCHES_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_bls_bisection_batches_total",
+    "Coalesced batches that failed and entered bisection blame",
+)
+BLS_BISECTION_DISPATCHES_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_bls_bisection_dispatches_total",
+    "Extra verification dispatches performed while bisecting failed batches",
+)
+BLS_BISECTION_BLAMED_SETS_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_bls_bisection_blamed_sets_total",
+    "Signature sets individually blamed (rejected) by bisection",
 )

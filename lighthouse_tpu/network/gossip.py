@@ -30,6 +30,7 @@ import hashlib
 import json
 import random
 import socket
+import struct
 import threading
 import time
 from collections import OrderedDict
@@ -112,6 +113,11 @@ class GossipNode:
         self._peer_ids: dict[socket.socket, str] = {}
         self._dialed: set[tuple] = set()  # outbound addrs (dial dedup)
         self._sock_dial_addr: dict[socket.socket, tuple] = {}
+        # _peers_lock guards every compound mutation/iteration of the
+        # shared peer/mesh/gossip state below (_peers, _peer_ids, _dialed,
+        # _mesh, _mcache, _recent, _promises) — receiver threads and the
+        # heartbeat all touch them. Sends and PeerDB calls happen OUTSIDE
+        # the lock (sendall can block; _drop_peer re-acquires it).
         self._peers_lock = threading.Lock()
         self._mesh: dict[str, set[socket.socket]] = {}
         self._seen: OrderedDict[bytes, None] = OrderedDict()
@@ -183,14 +189,19 @@ class GossipNode:
         threading.Thread(target=self._recv_loop, args=(sock,), daemon=True).start()
 
     def _drop_peer(self, sock: socket.socket) -> None:
+        pid = self._peer_id(sock)  # before the mapping is dropped below
         with self._peers_lock:
             self._peers.pop(sock, None)
+            # drop the id mapping too: a stale entry would leak per
+            # reconnect and make report_invalid_message double-count
+            # on_disconnect against sockets long dead
+            self._peer_ids.pop(sock, None)
             dialed = self._sock_dial_addr.pop(sock, None)
             if dialed is not None:
                 self._dialed.discard(dialed)  # allow a future redial
             for mesh in self._mesh.values():
                 mesh.discard(sock)
-        self.peer_db.on_disconnect(self._peer_id(sock))
+        self.peer_db.on_disconnect(pid)
         try:
             sock.close()
         except OSError:
@@ -207,13 +218,25 @@ class GossipNode:
     # -- wire ------------------------------------------------------------------
 
     def _recv_loop(self, sock: socket.socket) -> None:
-        try:
-            while self._running:
+        while self._running:
+            try:
                 frame = _recv_frame(sock, cap=MAX_MESSAGE)
+            except (OSError, ValueError, struct.error):
+                # transport death, EOF, or an unframeable stream: reap the
+                # peer — never leak a half-dead socket in _peers/_mesh
+                self._drop_peer(sock)
+                return
+            try:
                 self._on_frame(frame, source=sock)
-        except Exception:  # noqa: BLE001 — any escape must reap the peer,
-            # never leak a half-dead socket in _peers/_mesh
-            self._drop_peer(sock)
+            except Exception:  # noqa: BLE001 — an INTERNAL fault (e.g. a
+                # race in our own bookkeeping) must not be charged to a
+                # healthy peer: keep the link, skip the frame — but COUNT
+                # it, or a systematic handler bug becomes invisible total
+                # gossip loss
+                from ..common.metrics import GOSSIP_INTERNAL_ERRORS_TOTAL
+
+                GOSSIP_INTERNAL_ERRORS_TOTAL.inc()
+                continue
 
     def _mark_seen(self, mid: bytes) -> bool:
         """True if novel (and marks it)."""
@@ -244,7 +267,8 @@ class GossipNode:
                 self._drop_peer(source)
             return
         mid = message_id(payload)
-        self._promises.pop(mid, None)  # any promise on this id is fulfilled
+        with self._peers_lock:
+            self._promises.pop(mid, None)  # any promise on this id is fulfilled
         if not self._mark_seen(mid):
             return
         self._remember(mid, topic, frame)
@@ -271,31 +295,36 @@ class GossipNode:
         if isinstance(hello, str) and hello:
             # identity handshake: re-key the connection to the logical id
             # (carrying over nothing — scores live in the PeerDB by id)
-            prev = self._peer_ids.get(source)
-            self._peer_ids[source] = hello
+            with self._peers_lock:
+                prev = self._peer_ids.get(source)
+                self._peer_ids[source] = hello
             if prev is not None and prev != hello:
                 self.peer_db.on_disconnect(prev)
             if not self.peer_db.on_connect(hello):
                 self._drop_peer(source)  # known-banned identity
                 return
-        for topic in ctrl.get("graft", []):
-            # GRAFT is refused with PRUNE when the peer is graylisted (v1.1
-            # score gate) OR the mesh is already at D_HIGH — admitting past
-            # the bound and trimming at the next heartbeat leaves windows
-            # where the mesh exceeds its contract (gossipsub spec: a full
-            # mesh answers GRAFT with PRUNE immediately). The mesh entry is
-            # created only on actual admission, so refused GRAFTs (e.g. a
-            # graylisted peer spamming random topic names) cannot mint
-            # unbounded empty mesh entries.
-            mesh = self._mesh.get(str(topic), ())
-            if self.peer_db.is_usable(self._peer_id(source)) and (
-                source in mesh or len(mesh) < self.d_high
-            ):
-                self._mesh.setdefault(str(topic), set()).add(source)
-            else:
-                self._send(source, encode_control({"prune": [topic]}))
-        for topic in ctrl.get("prune", []):
-            self._mesh.get(str(topic), set()).discard(source)
+        prunes = []
+        usable = self.peer_db.is_usable(self._peer_id(source))
+        with self._peers_lock:
+            for topic in ctrl.get("graft", []):
+                # GRAFT is refused with PRUNE when the peer is graylisted
+                # (v1.1 score gate) OR the mesh is already at D_HIGH —
+                # admitting past the bound and trimming at the next
+                # heartbeat leaves windows where the mesh exceeds its
+                # contract (gossipsub spec: a full mesh answers GRAFT with
+                # PRUNE immediately). The mesh entry is created only on
+                # actual admission, so refused GRAFTs (e.g. a graylisted
+                # peer spamming random topic names) cannot mint unbounded
+                # empty mesh entries.
+                mesh = self._mesh.get(str(topic), ())
+                if usable and (source in mesh or len(mesh) < self.d_high):
+                    self._mesh.setdefault(str(topic), set()).add(source)
+                else:
+                    prunes.append(topic)
+            for topic in ctrl.get("prune", []):
+                self._mesh.get(str(topic), set()).discard(source)
+        if prunes:
+            self._send(source, encode_control({"prune": prunes}))
         wanted = []
         ihave = ctrl.get("ihave", {})
         if not isinstance(ihave, dict):
@@ -305,69 +334,92 @@ class GossipNode:
                 mid = bytes.fromhex(h)
                 with self._seen_lock:
                     novel = mid not in self._seen
-                if novel and mid not in self._promises:
-                    self._promises[mid] = (source, time.monotonic() + IWANT_PROMISE_TTL)
-                    wanted.append(h)
+                if not novel:
+                    continue
+                with self._peers_lock:
+                    if mid not in self._promises:
+                        self._promises[mid] = (
+                            source,
+                            time.monotonic() + IWANT_PROMISE_TTL,
+                        )
+                        wanted.append(h)
         if wanted:
             self._send(source, encode_control({"iwant": wanted}))
         for h in ctrl.get("iwant", []):
-            got = self._mcache.get(bytes.fromhex(h))
+            with self._peers_lock:
+                got = self._mcache.get(bytes.fromhex(h))
             if got is not None:
                 self._send(source, got[1])
 
     def _remember(self, mid: bytes, topic: str, frame: bytes) -> None:
-        self._mcache[mid] = (topic, frame)
-        while len(self._mcache) > MCACHE_SIZE:
-            self._mcache.popitem(last=False)
-        self._recent.append((mid, topic))
+        with self._peers_lock:
+            self._mcache[mid] = (topic, frame)
+            while len(self._mcache) > MCACHE_SIZE:
+                self._mcache.popitem(last=False)
+            self._recent.append((mid, topic))
 
     # -- mesh maintenance (gossipsub heartbeat) --------------------------------
 
     def _ensure_mesh(self, topic: str) -> None:
-        mesh = self._mesh.setdefault(topic, set())
-        if len(mesh) >= self.d_low:
-            return
         with self._peers_lock:
+            mesh = self._mesh.setdefault(topic, set())
+            if len(mesh) >= self.d_low:
+                return
             candidates = [
                 p
                 for p in self._peers
                 if p not in mesh and self.peer_db.is_usable(self._peer_id(p))
             ]
-        random.shuffle(candidates)
-        for p in candidates[: self.d - len(mesh)]:
-            mesh.add(p)
+            random.shuffle(candidates)
+            grafted = candidates[: self.d - len(mesh)]
+            mesh.update(grafted)
+        for p in grafted:
             self._send(p, encode_control({"graft": [topic]}))
 
     def heartbeat(self) -> None:
         """One gossipsub heartbeat: mesh degree maintenance, IHAVE gossip to
-        non-mesh peers, broken-promise accounting."""
+        non-mesh peers, broken-promise accounting. All shared-state reads
+        and mutations happen under _peers_lock; sends and PeerDB penalties
+        happen outside it."""
         # mesh upkeep
-        for topic, mesh in list(self._mesh.items()):
-            if len(mesh) < self.d_low:
-                self._ensure_mesh(topic)
-            elif len(mesh) > self.d_high:
-                for p in random.sample(sorted(mesh, key=id), len(mesh) - self.d):
-                    mesh.discard(p)
-                    self._send(p, encode_control({"prune": [topic]}))
+        low, pruned = [], []
+        with self._peers_lock:
+            for topic in list(self._mesh):
+                mesh = self._mesh[topic]
+                if len(mesh) < self.d_low:
+                    low.append(topic)
+                elif len(mesh) > self.d_high:
+                    for p in random.sample(sorted(mesh, key=id), len(mesh) - self.d):
+                        mesh.discard(p)
+                        pruned.append((p, topic))
+        for topic in low:
+            self._ensure_mesh(topic)
+        for p, topic in pruned:
+            self._send(p, encode_control({"prune": [topic]}))
         # lazy gossip: advertise this window's ids to non-mesh peers
-        recent, self._recent = self._recent, []
+        with self._peers_lock:
+            recent, self._recent = self._recent, []
         by_topic: dict[str, list[str]] = {}
         for mid, topic in recent[-256:]:
             by_topic.setdefault(topic, []).append(mid.hex())
         for topic, mids in by_topic.items():
-            mesh = self._mesh.get(topic, set())
             with self._peers_lock:
+                mesh = self._mesh.get(topic, set())
                 others = [p for p in self._peers if p not in mesh]
             for p in random.sample(others, min(self.d_lazy, len(others))):
                 self._send(p, encode_control({"ihave": {topic: mids}}))
         # broken promises
         now = time.monotonic()
-        for mid, (peer, deadline) in list(self._promises.items()):
-            if deadline < now:
-                del self._promises[mid]
-                rec = self.peer_db.penalize(self._peer_id(peer), PENALTY_BROKEN_PROMISE)
-                if rec.banned:
-                    self._drop_peer(peer)
+        broken = []
+        with self._peers_lock:
+            for mid, (peer, deadline) in list(self._promises.items()):
+                if deadline < now:
+                    del self._promises[mid]
+                    broken.append(peer)
+        for peer in broken:
+            rec = self.peer_db.penalize(self._peer_id(peer), PENALTY_BROKEN_PROMISE)
+            if rec.banned:
+                self._drop_peer(peer)
 
     def _heartbeat_loop(self) -> None:
         while self._running:
@@ -390,7 +442,9 @@ class GossipNode:
             pass  # dead peer reaped by its recv loop
 
     def _push_to_mesh(self, topic: str, frame: bytes, exclude=None) -> None:
-        for p in list(self._mesh.get(topic, ())):
+        with self._peers_lock:
+            targets = list(self._mesh.get(topic, ()))
+        for p in targets:
             if p is not exclude:
                 self._send(p, frame)
 

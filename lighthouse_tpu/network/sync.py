@@ -67,18 +67,20 @@ def _download_and_import(service, rotation: _PeerRotation, batch: Batch, importe
     """Shared download-with-retry loop for both sync machines.
 
     Rotates peers (bounded attempts), downloads the batch span, and hands
-    non-empty answers to `importer(peer_id, blocks) -> bool`. An EMPTY
-    answer is only accepted as a genuinely block-less span when EVERY live
-    peer answered empty — a single lagging/lying peer cannot make the
-    machine skip a span (range_sync/batch.rs marks batches AwaitingValidation
-    for the same reason).
+    non-empty answers to `importer(peer_id, blocks)`. The importer returns
+    True (imported), False (bad batch — blame the peer), or None ("this
+    span cannot make progress": nothing behind the frontier, or the whole
+    answer breaks the hash chain AT the frontier because the parent sits
+    below the requested window). None answers are treated exactly like
+    empty ones: they are a VERDICT, not a failure, accepted only when
+    EVERY live peer agrees — a single lagging/lying peer cannot make the
+    machine skip a span (range_sync/batch.rs marks batches
+    AwaitingValidation for the same reason), and honest peers serving a
+    fully-empty span no longer burn attempts into FAILED (the caller
+    widens its window instead).
 
     ExecutionEngineError raised by `importer` propagates: an EL outage is
-    our fault, not the peer's, and must not burn peer attempts.
-
-    Empty answers do NOT count against MAX_BATCH_ATTEMPTS (they are a
-    verdict, not a failure) — every live peer gets polled before the
-    all-empty acceptance is decided."""
+    our fault, not the peer's, and must not burn peer attempts."""
     empty_peers: set[str] = set()
     while batch.attempts < MAX_BATCH_ATTEMPTS:
         peers = service.network.peer_ids(service.node_id)
@@ -93,12 +95,13 @@ def _download_and_import(service, rotation: _PeerRotation, batch: Batch, importe
             batch.failed_peers.add(peer)
             batch.attempts += 1
             continue
-        if not blocks:
+        verdict = importer(peer, blocks) if blocks else None
+        if verdict is True:
+            return True
+        if verdict is None:
             empty_peers.add(peer)
             batch.failed_peers.add(peer)  # rotate on; verdict at the end
             continue
-        if importer(peer, blocks):
-            return True
         batch.failed_peers.add(peer)
         batch.attempts += 1
     live = set(service.network.peer_ids(service.node_id))
@@ -211,13 +214,30 @@ class BackFillSync:
     def _process_batch(self, batch: Batch) -> bool:
         chain = self.service.client.chain
 
-        def importer(peer: str, blocks) -> bool:
+        def importer(peer: str, blocks):
             # keep only the span behind the frontier (peers may over-answer)
             blocks = [
                 b for b in blocks if int(b.message.slot) < chain.oldest_block_slot
             ]
             if not blocks:
-                return False
+                return None  # nothing behind the frontier: empty verdict
+            # A batch that cannot LINK to the frontier at all — no block in
+            # the answer is the frontier's parent — is indistinguishable
+            # from a fully-empty span whose parent sits below the window:
+            # every honest peer would answer the same way. Treat it like
+            # the empty verdict so tick() widens the window instead of
+            # burning peer attempts into FAILED. A batch that DOES contain
+            # the parent but breaks deeper is a bad batch: blame the peer.
+            # Walk descending so an honest answer (parent = highest slot)
+            # short-circuits after one root.
+            parent = chain.backfill_parent_root
+            if not any(
+                type(b.message).hash_tree_root(b.message) == parent
+                for b in sorted(
+                    blocks, key=lambda b: int(b.message.slot), reverse=True
+                )
+            ):
+                return None
             try:
                 n = chain.import_historical_block_batch(blocks)
             except Exception:  # noqa: BLE001 — chain-break / bad signature

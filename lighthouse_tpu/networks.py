@@ -64,6 +64,26 @@ def network_config(name: str) -> tuple[str, ChainSpec]:
         ) from None
 
 
+def resolve_spec(
+    preset_name: str, network: str | None, testnet_dir: str | None
+) -> tuple[str, ChainSpec | None]:
+    """Shared --network/--testnet-dir resolution for CLI commands:
+    a named network supplies (preset, base spec); a testnet dir's
+    config.yaml overrides on top of that base (or the preset default).
+    Returns (preset_name, spec-or-None); None means 'use the preset
+    default'. BN and VC MUST resolve identically or duty signatures land
+    in the wrong fork domains."""
+    spec = None
+    if network is not None:
+        preset_name, spec = network_config(network)
+    if testnet_dir:
+        base = spec
+        if base is None:
+            base = MINIMAL_SPEC if preset_name == "minimal" else MAINNET_SPEC
+        spec = load_config_yaml(pathlib.Path(testnet_dir) / "config.yaml", base=base)
+    return preset_name, spec
+
+
 def load_config_yaml(path: str | pathlib.Path, base: ChainSpec | None = None) -> ChainSpec:
     """Apply a consensus-spec config.yaml onto `base` (default: mainnet
     spec). Unknown keys are ignored; known keys are type-checked by their

@@ -74,10 +74,16 @@ class ProcessorStats:
 
 
 class BeaconProcessor:
-    def __init__(self, bounds: dict | None = None):
+    def __init__(self, bounds: dict | None = None, coalescer=None):
         self.bounds = dict(DEFAULT_QUEUE_BOUNDS)
         if bounds:
             self.bounds.update(bounds)
+        # optional crypto.bls.batch_verifier.BatchVerifier: gossip
+        # attestation/aggregate/sync-message handlers verify through it
+        # (cross-caller coalescing; blocks keep their dedicated batch) and
+        # drain() kicks it when the queues empty so a partial batch is not
+        # left waiting out its deadline on an idle device
+        self.coalescer = coalescer
         self.queues: dict[WorkType, deque] = {wt: deque() for wt in WorkType}
         # enqueue timestamps, shadowing self.queues op-for-op (append ↔
         # append, pop ↔ pop, popleft ↔ popleft) so drains can attribute
@@ -204,4 +210,8 @@ class BeaconProcessor:
             ):
                 handlers[batch.work_type](batch.items)
             n += 1
+        if self.coalescer is not None:
+            # the drain produced no more work: the device is about to go
+            # idle, so flush any partially-filled coalesced batch now
+            self.coalescer.kick()
         return n

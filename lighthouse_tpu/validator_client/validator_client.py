@@ -515,7 +515,11 @@ class BeaconNodeApi:
             )
         except StateTransitionError:
             return False
-        if not ctx.bls.verify_signature_sets([s]):
+        # single-set path: rides a shared coalesced device batch when the
+        # BatchVerifier service is running (crypto/bls/batch_verifier.py)
+        from ..crypto.bls.batch_verifier import verify_sets
+
+        if not verify_sets(ctx.bls, [s])[0]:
             return False
         vk = bytes(state.validators[message.validator_index].pubkey)
         positions = self.sync_duties([vk], int(message.slot)).get(vk)

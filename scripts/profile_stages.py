@@ -13,6 +13,11 @@ the gap is attributable:
     memory) -> restructure into loops / split dispatches.
 
 Run: python scripts/profile_stages.py   (on the bench platform)
+     python scripts/profile_stages.py --coalesce
+         concurrent-submitter profile of the cross-caller BatchVerifier
+         (crypto/bls/batch_verifier.py) through the same span tracer:
+         dispatch count vs caller count, coalesced batch sizes, waits.
+         Env: PROFILE_COALESCE_CALLERS (64), PROFILE_COALESCE_ROUNDS (2).
 """
 
 import os
@@ -47,6 +52,87 @@ def med(fn, label, reps=REPS):
             fn()
         ts.append(time.perf_counter() - t0)
     return statistics.median(ts)
+
+
+def coalesce_main() -> None:
+    """--coalesce: the concurrent-submitter scenario through the PR-1 span
+    tracer — N threads each submitting single sets to the BatchVerifier,
+    reported via the same spans/metrics a /metrics scrape would show
+    (coalesced batch sizes, waits, dispatch count, per-stage breakdown)."""
+    import threading
+
+    import jax
+
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    from lighthouse_tpu.common.metrics import (
+        BLS_COALESCE_WAIT_SECONDS,
+        BLS_COALESCED_BATCH_SIZE,
+    )
+    from lighthouse_tpu.common.tracing import TRACER, span
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls.batch_verifier import BatchVerifier
+
+    n_callers = int(os.environ.get("PROFILE_COALESCE_CALLERS", "64"))
+    rounds = int(os.environ.get("PROFILE_COALESCE_ROUNDS", "2"))
+    b = bls.backend("jax")
+    pairs = [b.interop_keypair(i) for i in range(8)]
+    sets = []
+    for i in range(n_callers):
+        sk, pk = pairs[i % 8]
+        msg = bytes([i % 8]) * 32
+        sets.append(b.SignatureSet(signature=sk.sign(msg), signing_keys=[pk], message=msg))
+
+    print(f"platform={jax.default_backend()} callers={n_callers} rounds={rounds}",
+          flush=True)
+    # warm the kernel buckets outside the measurement: S=4 (single-set
+    # dispatches) AND the full-caller bucket — coalesced batches land on
+    # intermediate pow2 buckets too, but these two bound the common cases
+    # (a cold cache may still compile an intermediate shape in-window)
+    assert b.verify_signature_sets(sets[:1])
+    assert b.verify_signature_sets(sets)
+
+    svc = BatchVerifier(b).start()
+    try:
+        t0 = time.perf_counter()
+
+        def caller(s):
+            for _ in range(rounds):
+                with span("bls_coalesced_submit"):
+                    ok = svc.submit([s]).result(timeout=600.0)[0]
+                assert ok
+
+        threads = [threading.Thread(target=caller, args=(s,)) for s in sets]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        sec = time.perf_counter() - t0
+    finally:
+        svc.stop()
+
+    total = n_callers * rounds
+    print(f"total sets               {total}", flush=True)
+    print(f"device dispatches        {svc.dispatches}  "
+          f"(uncoalesced path would pay {total})", flush=True)
+    print(f"throughput               {total / sec:9.2f} sets/s", flush=True)
+    print(f"mean coalesced batch     "
+          f"{svc.sets_coalesced / max(1, svc.dispatches):9.2f} sets", flush=True)
+    if BLS_COALESCE_WAIT_SECONDS.count:
+        print(f"mean coalesce wait       "
+              f"{BLS_COALESCE_WAIT_SECONDS.sum / BLS_COALESCE_WAIT_SECONDS.count * 1e3:9.2f} ms",
+              flush=True)
+    print(f"batch-size histogram n   {BLS_COALESCED_BATCH_SIZE.count}", flush=True)
+
+    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
+    for stage, rec in TRACER.stage_report().items():
+        print(
+            f"  {stage:22s} n={rec['count']:3d}"
+            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
+            f"  total={rec['total_s'] * 1e3:9.2f} ms",
+            flush=True,
+        )
 
 
 def main() -> None:
@@ -179,4 +265,7 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    if "--coalesce" in sys.argv:
+        coalesce_main()
+    else:
+        main()

@@ -101,3 +101,42 @@ def test_lcli_insecure_validators_roundtrip(tmp_path):
     for i in range(3):
         secret = ks.decrypt(ks.load(str(out / f"validator_{i}.json")), str(i))
         assert secret == bls.interop_secret_key(i).to_bytes()
+
+
+def test_vc_ctx_resolves_spec_from_testnet_dir(tmp_path):
+    """validator-client --testnet-dir builds ctx.spec from the same
+    config.yaml a lcli-generated testnet's beacon nodes use, so duty
+    signatures are made in the correct fork domains (ADVICE r5)."""
+    from lighthouse_tpu.cli import _vc_ctx, build_parser
+    from lighthouse_tpu.types import FAR_FUTURE_EPOCH
+
+    rc = main(
+        ["lcli", "--preset", "minimal", "--bls-backend", "fake", "new-testnet",
+         "--testnet-dir", str(tmp_path / "net"), "--validators", "4",
+         "--altair-fork-epoch", "0"]
+    )
+    assert rc == 0
+
+    args = build_parser().parse_args(
+        ["validator-client", "--preset", "minimal", "--bls-backend", "fake",
+         "--testnet-dir", str(tmp_path / "net")]
+    )
+    ctx = _vc_ctx(args)
+    assert ctx.spec.altair_fork_epoch == 0  # from config.yaml, not the default
+
+    # without --testnet-dir the preset default spec is kept
+    args = build_parser().parse_args(
+        ["validator-client", "--preset", "minimal", "--bls-backend", "fake"]
+    )
+    assert _vc_ctx(args).spec.altair_fork_epoch == FAR_FUTURE_EPOCH
+
+
+def test_vc_ctx_resolves_named_network():
+    from lighthouse_tpu.cli import _vc_ctx, build_parser
+
+    args = build_parser().parse_args(
+        ["validator-client", "--bls-backend", "fake", "--network", "interop-merge"]
+    )
+    ctx = _vc_ctx(args)
+    assert ctx.spec.altair_fork_epoch == 0
+    assert ctx.spec.bellatrix_fork_epoch == 0

@@ -17,6 +17,7 @@ def test_registered_metric_names_are_prefixed_snake_case():
     import lighthouse_tpu.chain.validator_monitor  # noqa: F401
     import lighthouse_tpu.common.metrics  # noqa: F401
     import lighthouse_tpu.common.tracing  # noqa: F401
+    import lighthouse_tpu.crypto.bls.batch_verifier  # noqa: F401
     import lighthouse_tpu.validator_client.validator_client  # noqa: F401
     from lighthouse_tpu.common.metrics import REGISTRY
 
@@ -24,6 +25,24 @@ def test_registered_metric_names_are_prefixed_snake_case():
     assert names, "the global registry should not be empty"
     bad = [n for n in names if not NAME_RE.fullmatch(n)]
     assert not bad, f"metric names violating the lighthouse_tpu_ snake_case convention: {bad}"
+
+
+def test_coalescer_metric_families_are_registered():
+    """The batch-coalescer families ISSUE 3 exports must exist on the
+    global registry under their contracted names."""
+    import lighthouse_tpu.crypto.bls.batch_verifier  # noqa: F401
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    names = set(REGISTRY.names())
+    for expected in (
+        "lighthouse_tpu_bls_coalesced_batch_size",
+        "lighthouse_tpu_bls_coalesce_wait_seconds",
+        "lighthouse_tpu_bls_coalesced_dispatches_total",
+        "lighthouse_tpu_bls_bisection_batches_total",
+        "lighthouse_tpu_bls_bisection_dispatches_total",
+        "lighthouse_tpu_bls_bisection_blamed_sets_total",
+    ):
+        assert expected in names, f"missing metric family {expected}"
 
 
 def test_histogram_families_use_unit_suffixes():

@@ -172,3 +172,79 @@ def test_range_sync_rotates_away_from_dead_peer():
 
     fserv.sync.on_status(n)
     assert follower.chain.head_root == producer.chain.head_root
+
+
+def test_backfill_widens_window_when_answers_break_at_the_frontier():
+    """A span whose answers cannot LINK to the frontier (the parent block
+    sits below the requested window; peers return only non-linking blocks)
+    must count as an empty verdict — the window widens backward — instead
+    of burning peer attempts into FAILED (ADVICE r5)."""
+    from types import SimpleNamespace
+
+    from lighthouse_tpu.network.sync import BackFillSync
+
+    class Msg:
+        def __init__(self, slot, root, parent_root):
+            self.slot = slot
+            self._root = root
+            self.parent_root = parent_root
+
+        def hash_tree_root(self):  # type(b.message).hash_tree_root(b.message)
+            return self._root
+
+    def block(slot, root, parent_root):
+        return SimpleNamespace(message=Msg(slot, root, parent_root))
+
+    class FakeChain:
+        """Mimics BeaconChain's backfill bookkeeping + hash-chain check."""
+
+        def __init__(self, frontier_slot, parent_root):
+            self.oldest_block_slot = frontier_slot
+            self.backfill_parent_root = parent_root
+            self.imported = []
+            self.ctx = SimpleNamespace(preset=SimpleNamespace(slots_per_epoch=8))
+
+        @property
+        def backfill_complete(self):
+            return self.oldest_block_slot <= 1
+
+        def import_historical_block_batch(self, blocks):
+            blocks = sorted(blocks, key=lambda b: b.message.slot, reverse=True)
+            expected = self.backfill_parent_root
+            for b in blocks:
+                if type(b.message).hash_tree_root(b.message) != expected:
+                    raise RuntimeError("historical batch breaks the hash chain")
+                expected = b.message.parent_root
+            tail = blocks[-1]
+            self.oldest_block_slot = int(tail.message.slot)
+            self.backfill_parent_root = tail.message.parent_root
+            self.imported.extend(blocks)
+            return len(blocks)
+
+    # canonical history: blocks at slots 1..5 only, then a 35-slot empty gap
+    # up to the checkpoint anchor at slot 40 — the frontier's parent (slot 5)
+    # sits far below the initial 2-epoch request window
+    roots = {i: bytes([i]) * 32 for i in range(6)}
+    roots[0] = b"\x00" * 32
+    canonical = [block(i, roots[i], roots[i - 1]) for i in range(1, 6)]
+    fork = block(30, b"\xff" * 32, b"\xee" * 32)  # a non-linking stray
+
+    class FakeNetwork:
+        def peer_ids(self, node_id):
+            return ["p1", "p2"]
+
+        def blocks_by_range_from(self, node_id, peer, start, count):
+            hits = [b for b in canonical if start <= b.message.slot < start + count]
+            # for the empty span peers still answer with a stray block that
+            # breaks the chain at the frontier (the pre-fix FAILED path)
+            return hits or [fork]
+
+    chain = FakeChain(frontier_slot=40, parent_root=roots[5])
+    service = SimpleNamespace(
+        client=SimpleNamespace(chain=chain), network=FakeNetwork(), node_id="f"
+    )
+    bf = BackFillSync(service)
+    bf.tick()
+    assert bf.state is SyncState.IDLE, "widening must reach the real history"
+    assert chain.oldest_block_slot == 1
+    assert len(chain.imported) == 5
