@@ -1,0 +1,29 @@
+"""Repo-native static analysis + runtime concurrency checking.
+
+Generic linters know Python; they do not know THIS repo's invariants —
+that a class owning a `threading.Lock` must write its shared attributes
+under it, that a thread run-loop may only swallow an exception if it
+counts the fault, that nothing on the `jax.jit` trace path may touch the
+host clock, and that every metric family is `lighthouse_tpu_`-prefixed
+snake_case. The advisor rounds found each of those broken by hand
+(gossip mesh mutated off-lock, a recv-loop blanket except reaping
+healthy peers); this package makes the whole class mechanical, so every
+future perf PR is gated by analyzers that encode the repo's threading
+and JAX-purity idioms.
+
+Two halves:
+
+  engine.py + lints.py   AST lint engine with four checkers (lock-guard,
+                         thread-hygiene, trace-purity, metric-name),
+                         driven by scripts/lint.py and gated in tier-1
+                         by tests/test_static_analysis.py.
+  lockcheck.py           opt-in runtime lock-order detector: instrumented
+                         Lock/RLock wrappers record per-thread acquisition
+                         edges into a global order graph; cycles (potential
+                         deadlocks) and device dispatch performed while
+                         holding a lock are violations. Activated per-test
+                         by conftest under LIGHTHOUSE_TPU_LOCKCHECK=1.
+"""
+
+from .engine import Finding, load_allowlist, run_lints  # noqa: F401
+from .lints import default_checkers  # noqa: F401

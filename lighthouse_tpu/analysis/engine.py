@@ -1,0 +1,166 @@
+"""AST lint engine: file walking, checker protocol, allowlist discipline.
+
+The engine is deliberately dependency-free (ast + pathlib only) so
+`scripts/lint.py` runs in seconds without importing jax or the package
+under analysis — analyzers read source, they never execute it.
+
+Checkers (analysis/lints.py) get one `ast.Module` per file and return
+`Finding`s. A finding's identity is `rule:path:symbol` — anchored to the
+enclosing class/function qualname rather than a line number, so
+allowlist entries survive unrelated edits to the same file.
+
+Allowlist policy (scripts/lint_allowlist.txt): every entry MUST carry a
+one-line justification after `  #` — an unexplained suppression is a
+config error, and an entry that no longer matches any finding is stale
+and fails `--check` (suppressions must not outlive the code they
+excused).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from pathlib import Path
+
+#: directories never linted (caches, bytecode)
+SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", ".pytest_cache"}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str  # checker name, e.g. "lock-guard"
+    path: str  # repo-relative posix path
+    line: int
+    symbol: str  # qualname anchor (Class.attr, Class.method, function)
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Allowlist identity: stable across line drift."""
+        return f"{self.rule}:{self.path}:{self.symbol}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "symbol": self.symbol,
+            "message": self.message,
+        }
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.symbol}: {self.message}"
+
+
+class Checker:
+    """One lint rule. Subclasses set `name` and implement `check`."""
+
+    name = "checker"
+
+    def check(self, tree: ast.Module, path: str, source: str) -> list[Finding]:
+        raise NotImplementedError
+
+
+class LintConfigError(Exception):
+    """Broken lint configuration (malformed/unjustified allowlist entry)."""
+
+
+@dataclass
+class AllowlistEntry:
+    key: str  # rule:path:symbol
+    justification: str
+    lineno: int  # in the allowlist file (for error messages)
+
+
+def load_allowlist(path: str | Path) -> list[AllowlistEntry]:
+    """Parse the allowlist; a missing justification is a hard error, not a
+    warning — suppressions are reviewed code."""
+    p = Path(path)
+    if not p.exists():
+        return []
+    entries: list[AllowlistEntry] = []
+    for lineno, raw in enumerate(p.read_text().splitlines(), start=1):
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, sep, justification = line.partition("#")
+        key = key.strip()
+        justification = justification.strip()
+        if key.count(":") != 2:
+            raise LintConfigError(
+                f"{p}:{lineno}: malformed entry {key!r} (want rule:path:symbol)"
+            )
+        if not sep or not justification:
+            raise LintConfigError(
+                f"{p}:{lineno}: allowlist entry {key!r} has no justification "
+                f"(append '  # why this finding is acceptable')"
+            )
+        entries.append(AllowlistEntry(key=key, justification=justification, lineno=lineno))
+    return entries
+
+
+def iter_python_files(paths, root: str | Path = ".") -> list[Path]:
+    """Expand files/directories into a sorted .py file list."""
+    root = Path(root)
+    out: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if not p.is_absolute():
+            p = root / p
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if not any(part in SKIP_DIRS for part in f.parts):
+                    out.append(f)
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def run_lints(paths, checkers, root: str | Path = ".") -> list[Finding]:
+    """Run every checker over every file; syntax errors surface as findings
+    (rule `parse-error`) rather than crashing the run."""
+    root = Path(root).resolve()
+    findings: list[Finding] = []
+    for f in iter_python_files(paths, root=root):
+        try:
+            rel = f.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = f.as_posix()
+        source = f.read_text()
+        try:
+            tree = ast.parse(source, filename=str(f))
+        except SyntaxError as e:
+            findings.append(
+                Finding(
+                    rule="parse-error",
+                    path=rel,
+                    line=e.lineno or 0,
+                    symbol="<module>",
+                    message=f"file does not parse: {e.msg}",
+                )
+            )
+            continue
+        for checker in checkers:
+            findings.extend(checker.check(tree, rel, source))
+    findings.sort(key=lambda x: (x.path, x.line, x.rule))
+    return findings
+
+
+def apply_allowlist(findings, entries):
+    """Split findings into (kept, suppressed); also return stale allowlist
+    entries (matched nothing — they must be deleted, not accumulated)."""
+    by_key: dict[str, AllowlistEntry] = {}
+    for e in entries:
+        if e.key in by_key:
+            raise LintConfigError(f"duplicate allowlist entry for {e.key}")
+        by_key[e.key] = e
+    used: set[str] = set()
+    kept, suppressed = [], []
+    for f in findings:
+        if f.key in by_key:
+            used.add(f.key)
+            suppressed.append(f)
+        else:
+            kept.append(f)
+    stale = [e for e in entries if e.key not in used]
+    return kept, suppressed, stale

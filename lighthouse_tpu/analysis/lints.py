@@ -1,0 +1,654 @@
+"""The repo-native checkers: lock-guard, thread-hygiene, trace-purity,
+metric-name.
+
+Each encodes an invariant this codebase already relies on (and has been
+burned by — the gossip mesh off-lock mutation, the recv-loop blanket
+except that reaped healthy peers):
+
+  lock-guard      a class that owns a `threading.Lock`/`RLock` gets its
+                  mutable attributes classified: an attribute written at
+                  least once under `with self._lock:` is lock-protected,
+                  and any write to it outside a lock block (construction
+                  aside) is a violation. Convention: methods named
+                  `*_locked` are documented as called-with-lock-held and
+                  count as locked writes.
+  thread-hygiene  a function used as a `threading.Thread` target may only
+                  swallow a blanket exception (bare / Exception /
+                  BaseException) if the handler re-raises or increments an
+                  error metric (`<counter>.inc(...)`) — a silent
+                  swallow-and-continue hides systematic faults, a silent
+                  swallow-and-return kills the thread invisibly. Non-daemon
+                  threads must be joinable (handle kept + `.join(` reachable).
+  trace-purity    functions reaching `jax.jit` / `vmap` / `pmap` /
+                  `shard_map` (directly or via the module-local call graph)
+                  must stay trace-pure: no `time.*` / `random.*` /
+                  `secrets.*` / `np.random.*`, no `print`, no `.item()` /
+                  `float()`/`int()` host sync on traced values, no
+                  global/nonlocal rebinding, no `self.*` mutation. Any of
+                  those inside a jitted trace is a silent host-sync stall
+                  (or a value frozen at trace time) on the BLS hot path.
+  metric-name     every literal registered on the metrics registry
+                  (`REGISTRY.counter/gauge/histogram[_vec]`) must be
+                  `lighthouse_tpu_`-prefixed snake_case, and histogram
+                  families must carry a unit suffix. The runtime audit in
+                  tests/test_metrics_lint.py imports METRIC_NAME_RE /
+                  HISTOGRAM_UNIT_SUFFIXES from here, so the two cannot
+                  drift apart.
+
+Known analysis boundaries (documented, deliberate):
+  - lock-guard sees `self.attr` writes and mutator-method calls on
+    `self.attr`; a local alias (`bucket = self.buckets[d]; bucket.append`)
+    is invisible, as is state guarded by module-level locks.
+  - trace-purity's call graph is module-local; cross-module helpers are
+    checked in their own module only if that module jits something.
+  - thread-hygiene resolves `target=` references by name within the module;
+    dynamically chosen targets are not followed.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .engine import Checker, Finding
+
+# -- shared AST helpers --------------------------------------------------------
+
+LOCK_FACTORIES = {"Lock", "RLock"}
+
+#: container/collection methods that mutate the receiver in place
+MUTATOR_METHODS = {
+    "append", "appendleft", "add", "discard", "remove", "pop", "popleft",
+    "popitem", "clear", "update", "setdefault", "extend", "insert", "sort",
+}
+
+#: construction/teardown methods whose writes happen before/after sharing
+CONSTRUCTION_METHODS = {"__init__", "__post_init__", "__new__", "__del__"}
+
+
+def _attr_chain(node: ast.expr) -> list[str]:
+    """`a.b.c` -> ["a", "b", "c"]; [] when the base is not a Name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        parts.reverse()
+        return parts
+    return []
+
+
+def _is_lock_factory_call(node: ast.expr) -> bool:
+    """threading.Lock() / threading.RLock() / bare Lock()/RLock()."""
+    if not isinstance(node, ast.Call):
+        return False
+    chain = _attr_chain(node.func)
+    return chain in (["threading", "Lock"], ["threading", "RLock"]) or (
+        len(chain) == 1 and chain[0] in LOCK_FACTORIES
+    )
+
+
+def _self_attr(node: ast.expr) -> str | None:
+    """`self.X` -> "X", else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _stmt_writes(stmt: ast.stmt) -> list[tuple[str, int]]:
+    """(attr, line) pairs a SIMPLE statement writes on `self`: assignment /
+    augassign / del targets `self.X` or `self.X[...]`, plus in-place mutator
+    calls `self.X.pop(...)` anywhere in the statement (including as an
+    assignment's right-hand side)."""
+    out: list[tuple[str, int]] = []
+    targets: list[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, ast.AugAssign):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.Delete):
+        targets = list(stmt.targets)
+    for t in targets:
+        nodes = list(t.elts) if isinstance(t, (ast.Tuple, ast.List)) else [t]
+        for node in nodes:
+            if isinstance(node, ast.Subscript):
+                node = node.value
+            attr = _self_attr(node)
+            if attr is not None:
+                out.append((attr, stmt.lineno))
+    out.extend(_mutator_calls(stmt))
+    return out
+
+
+def _mutator_calls(node: ast.AST) -> list[tuple[str, int]]:
+    """(attr, line) for every in-place mutator call on `self.X` anywhere in
+    this (sub)tree — also used for compound-statement HEADERS, where
+    `while self._q.pop():` is a write even though the loop body is walked
+    separately."""
+    out: list[tuple[str, int]] = []
+    for sub in ast.walk(node):
+        if (
+            isinstance(sub, ast.Call)
+            and isinstance(sub.func, ast.Attribute)
+            and sub.func.attr in MUTATOR_METHODS
+        ):
+            recv = sub.func.value
+            if isinstance(recv, ast.Subscript):
+                recv = recv.value
+            attr = _self_attr(recv)
+            if attr is not None:
+                out.append((attr, sub.lineno))
+    return out
+
+
+def _collect_qualnames(tree: ast.Module):
+    """Every function def in the module with its dotted qualname."""
+    out: list[tuple[ast.FunctionDef | ast.AsyncFunctionDef, str]] = []
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                out.append((child, qual))
+                walk(child, f"{qual}.")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+# -- lock-guard ----------------------------------------------------------------
+
+
+class LockGuardChecker(Checker):
+    name = "lock-guard"
+
+    def check(self, tree, path, source):
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(node, path))
+        return findings
+
+    def _lock_attrs(self, cls: ast.ClassDef) -> set[str]:
+        locks: set[str] = set()
+        # dataclass style: `_lock: Lock = field(default_factory=threading.Lock)`
+        for stmt in cls.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                v = stmt.value
+                if isinstance(v, ast.Call) and _attr_chain(v.func)[-1:] == ["field"]:
+                    for kw in v.keywords:
+                        if kw.arg == "default_factory" and _attr_chain(kw.value)[
+                            -1:
+                        ] in (["Lock"], ["RLock"]):
+                            locks.add(stmt.target.id)
+        # `self._lock = threading.Lock()` anywhere in a method
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_lock_factory_call(node.value):
+                for t in node.targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        locks.add(attr)
+        return locks
+
+    def _check_class(self, cls: ast.ClassDef, path: str) -> list[Finding]:
+        locks = self._lock_attrs(cls)
+        if not locks:
+            return []
+        # attr -> [(line, locked?)] write sites across all methods
+        writes: dict[str, list[tuple[int, bool]]] = {}
+
+        def record(stmt: ast.stmt, locked: bool) -> None:
+            for attr, line in _stmt_writes(stmt):
+                if attr not in locks:
+                    writes.setdefault(attr, []).append((line, locked))
+
+        def record_header(expr, locked: bool) -> None:
+            # compound-statement headers mutate too: `while self._q.pop():`
+            for attr, line in _mutator_calls(expr):
+                if attr not in locks:
+                    writes.setdefault(attr, []).append((line, locked))
+
+        def visit(stmt: ast.stmt, locked: bool) -> None:
+            if isinstance(stmt, ast.With):
+                holds = any(
+                    _self_attr(item.context_expr) in locks for item in stmt.items
+                )
+                for item in stmt.items:
+                    record_header(item.context_expr, locked)
+                for s in stmt.body:
+                    visit(s, locked or holds)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                record_header(stmt.test, locked)
+                for s in stmt.body + stmt.orelse:
+                    visit(s, locked)
+            elif isinstance(stmt, ast.For):
+                record_header(stmt.iter, locked)
+                for s in stmt.body + stmt.orelse:
+                    visit(s, locked)
+            elif isinstance(stmt, ast.Try):
+                for s in stmt.body + stmt.orelse + stmt.finalbody:
+                    visit(s, locked)
+                for h in stmt.handlers:
+                    for s in h.body:
+                        visit(s, locked)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # a nested function runs later, when the def site's lock is
+                # no longer (knowably) held
+                for s in stmt.body:
+                    visit(s, False)
+            elif isinstance(stmt, ast.ClassDef):
+                pass
+            else:
+                record(stmt, locked)
+
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name in CONSTRUCTION_METHODS:
+                continue  # happens-before publication: unguarded by design
+            # `*_locked` methods are called with the lock held by contract
+            assumed = method.name.endswith("_locked") or "_locked_" in method.name
+            for stmt in method.body:
+                visit(stmt, assumed)
+
+        findings = []
+        for attr, sites in sorted(writes.items()):
+            locked_lines = sorted(ln for ln, lk in sites if lk)
+            unlocked = sorted(ln for ln, lk in sites if not lk)
+            if locked_lines and unlocked:
+                for ln in unlocked:
+                    findings.append(
+                        Finding(
+                            rule=self.name,
+                            path=path,
+                            line=ln,
+                            symbol=f"{cls.name}.{attr}",
+                            message=(
+                                f"attribute '{attr}' is lock-protected (written "
+                                f"under a lock at line {locked_lines[0]}) but "
+                                f"written here without holding one of "
+                                f"{sorted(locks)}"
+                            ),
+                        )
+                    )
+        return findings
+
+
+# -- thread-hygiene ------------------------------------------------------------
+
+BLANKET_EXC_NAMES = {"Exception", "BaseException"}
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    return chain in (["threading", "Thread"], ["Thread"])
+
+
+class ThreadHygieneChecker(Checker):
+    name = "thread-hygiene"
+
+    def check(self, tree, path, source):
+        findings: list[Finding] = []
+        target_names: set[str] = set()
+        thread_calls: list[ast.Call] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_thread_ctor(node):
+                thread_calls.append(node)
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        chain = _attr_chain(kw.value)
+                        if chain:
+                            target_names.add(chain[-1])
+
+        # (a) blanket excepts inside thread-target run functions
+        for fn, qual in _collect_qualnames(tree):
+            if fn.name in target_names:
+                findings.extend(self._check_run_fn(fn, qual, path))
+
+        # (b) non-daemon threads need a reachable stop/join path
+        joined = {
+            _attr_chain(node.func)[-2]
+            for node in ast.walk(tree)
+            if isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and len(_attr_chain(node.func)) >= 2
+        }
+        for call in thread_calls:
+            daemon = next((kw for kw in call.keywords if kw.arg == "daemon"), None)
+            if daemon is not None and not (
+                isinstance(daemon.value, ast.Constant) and daemon.value.value is False
+            ):
+                continue  # daemon=True (or dynamic): dies with the process
+            assigned = _assignment_name_for(tree, call)
+            if assigned is not None and assigned in joined:
+                continue
+            target = next(
+                (
+                    ".".join(_attr_chain(kw.value)) or "<dynamic>"
+                    for kw in call.keywords
+                    if kw.arg == "target"
+                ),
+                "<unknown>",
+            )
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=path,
+                    line=call.lineno,
+                    symbol=f"Thread(target={target})",
+                    message=(
+                        "non-daemon thread without a reachable stop/join path: "
+                        "keep the handle and join it, or pass daemon=True"
+                    ),
+                )
+            )
+        return findings
+
+    def _check_run_fn(self, fn, qual: str, path: str) -> list[Finding]:
+        findings = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is not None:
+                names = (
+                    [n for e in node.type.elts for n in _attr_chain(e)[-1:]]
+                    if isinstance(node.type, ast.Tuple)
+                    else _attr_chain(node.type)[-1:]
+                )
+                if not any(n in BLANKET_EXC_NAMES for n in names):
+                    continue  # narrowed except: fine
+            if self._handler_accounts(node):
+                continue
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=path,
+                    line=node.lineno,
+                    symbol=qual,
+                    message=(
+                        "blanket except in a thread run-loop swallows faults "
+                        "silently: narrow it, re-raise, or count it via an "
+                        "error-metric .inc()"
+                    ),
+                )
+            )
+        return findings
+
+    @staticmethod
+    def _handler_accounts(handler: ast.ExceptHandler) -> bool:
+        """A blanket handler is acceptable when it re-raises or increments
+        an error metric — the fault stays visible either way."""
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "inc"
+            ):
+                return True
+        return False
+
+
+def _assignment_name_for(tree: ast.Module, call: ast.Call) -> str | None:
+    """The `X` of `X = threading.Thread(...)` / `self.X = ...`, else None."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and node.value is call:
+            for t in node.targets:
+                attr = _self_attr(t)
+                if attr is not None:
+                    return attr
+                if isinstance(t, ast.Name):
+                    return t.id
+    return None
+
+
+# -- trace-purity --------------------------------------------------------------
+
+TRACE_ENTRY_CALLS = {"jit", "vmap", "pmap", "shard_map", "grad", "value_and_grad"}
+IMPURE_MODULE_CALLS = {"time", "random", "secrets"}
+
+
+class TracePurityChecker(Checker):
+    name = "trace-purity"
+
+    def check(self, tree, path, source):
+        entries = self._trace_entries(tree)
+        if not entries:
+            return []
+        fns = _collect_qualnames(tree)
+        by_name: dict[str, list] = {}
+        for fn, qual in fns:
+            by_name.setdefault(fn.name, []).append((fn, qual))
+
+        # transitive closure over the module-local call graph
+        traced: set[str] = set()
+        frontier = [n for n in entries if n in by_name]
+        while frontier:
+            name = frontier.pop()
+            if name in traced:
+                continue
+            traced.add(name)
+            for fn, _ in by_name.get(name, []):
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                        if node.func.id in by_name and node.func.id not in traced:
+                            frontier.append(node.func.id)
+
+        findings: list[Finding] = []
+        seen: set[tuple] = set()
+        for fn, qual in fns:
+            if fn.name in traced:
+                for f in self._check_traced_fn(fn, qual, path):
+                    k = (f.line, f.message)
+                    if k not in seen:  # nested defs are walked once per level
+                        seen.add(k)
+                        findings.append(f)
+        return findings
+
+    @staticmethod
+    def _trace_entries(tree: ast.Module) -> set[str]:
+        """Function names handed to jit/vmap/pmap/shard_map, by decorator
+        (@jax.jit, @partial(shard_map, ...)) or by call (jax.jit(kernel),
+        including through a lambda wrapper)."""
+        entries: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if _attr_chain(dec)[-1:] and _attr_chain(dec)[-1] in TRACE_ENTRY_CALLS:
+                        entries.add(node.name)
+                    if isinstance(dec, ast.Call):
+                        heads = [_attr_chain(dec.func)] + [_attr_chain(a) for a in dec.args]
+                        if any(h[-1:] and h[-1] in TRACE_ENTRY_CALLS for h in heads):
+                            entries.add(node.name)
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if chain[-1:] and chain[-1] in TRACE_ENTRY_CALLS:
+                    for arg in node.args:
+                        target = _attr_chain(arg)
+                        if len(target) == 1:
+                            entries.add(target[0])
+                        elif isinstance(arg, ast.Lambda):
+                            for sub in ast.walk(arg.body):
+                                if isinstance(sub, ast.Call) and isinstance(
+                                    sub.func, ast.Name
+                                ):
+                                    entries.add(sub.func.id)
+        return entries
+
+    def _check_traced_fn(self, fn, qual: str, path: str) -> list[Finding]:
+        params = {
+            a.arg
+            for a in list(fn.args.args)
+            + list(fn.args.posonlyargs)
+            + list(fn.args.kwonlyargs)
+        }
+        findings: list[Finding] = []
+
+        def flag(node, what: str) -> None:
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=path,
+                    line=node.lineno,
+                    symbol=qual,
+                    message=(
+                        f"{what} inside a traced (jit/vmap/pmap/shard_map-"
+                        f"reachable) function: a host sync or a value frozen "
+                        f"at trace time on the device hot path"
+                    ),
+                )
+            )
+
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = _attr_chain(node.func)
+                if len(chain) >= 2 and chain[0] in IMPURE_MODULE_CALLS:
+                    flag(node, f"call to {'.'.join(chain)}")
+                elif len(chain) >= 3 and chain[0] in {"np", "numpy"} and chain[1] == "random":
+                    flag(node, f"call to {'.'.join(chain)}")
+                elif chain == ["print"]:
+                    flag(node, "print()")
+                elif isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                    flag(node, ".item() host sync")
+                elif (
+                    chain in (["float"], ["int"])
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in params
+                ):
+                    flag(node, f"{chain[0]}() on a traced argument")
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in MUTATOR_METHODS
+                    and _self_attr(node.func.value) is not None
+                ):
+                    flag(node, f"mutation of self.{_self_attr(node.func.value)}")
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = "global" if isinstance(node, ast.Global) else "nonlocal"
+                flag(node, f"{kind} rebinding")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        t = t.value
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        flag(node, f"mutation of self.{attr}")
+        return findings
+
+
+# -- metric-name ---------------------------------------------------------------
+
+#: the single source of truth for the naming convention; the runtime audit
+#: in tests/test_metrics_lint.py imports these.
+METRIC_NAME_RE = re.compile(r"^lighthouse_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+HISTOGRAM_UNIT_SUFFIXES = ("_seconds", "_slots", "_size", "_bytes")
+
+REGISTRATION_METHODS = {
+    "counter", "gauge", "histogram", "counter_vec", "gauge_vec", "histogram_vec",
+}
+
+
+class MetricNameChecker(Checker):
+    name = "metric-name"
+
+    def check(self, tree, path, source):
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in REGISTRATION_METHODS
+            ):
+                continue
+            recv = _attr_chain(node.func)[:-1]
+            # registration goes through a registry object; skip look-alike
+            # methods on unrelated receivers
+            if not any("registry" in part.lower() for part in recv):
+                continue
+            if not node.args or not (
+                isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=path,
+                        line=node.lineno,
+                        symbol=f"{'.'.join(recv)}.{node.func.attr}",
+                        message="metric name must be a string literal (lintable)",
+                    )
+                )
+                continue
+            metric = node.args[0].value
+            if not METRIC_NAME_RE.fullmatch(metric):
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=path,
+                        line=node.lineno,
+                        symbol=metric,
+                        message=(
+                            "metric name must be lighthouse_tpu_-prefixed "
+                            "snake_case (dashboards glob one prefix)"
+                        ),
+                    )
+                )
+            if node.func.attr in ("histogram", "histogram_vec") and not metric.endswith(
+                HISTOGRAM_UNIT_SUFFIXES
+            ):
+                findings.append(
+                    Finding(
+                        rule=self.name,
+                        path=path,
+                        line=node.lineno,
+                        symbol=metric,
+                        message=(
+                            f"histogram family needs a unit suffix "
+                            f"{HISTOGRAM_UNIT_SUFFIXES} (Prometheus convention)"
+                        ),
+                    )
+                )
+        return findings
+
+
+def registered_metric_names(tree: ast.Module) -> set[str]:
+    """Literal metric names registered through a registry object in this
+    module — the static counterpart of REGISTRY.names(), used by
+    tests/test_metrics_lint.py to prove the static checker sees every
+    family the runtime registry ends up holding."""
+    names: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in REGISTRATION_METHODS
+            and any("registry" in p.lower() for p in _attr_chain(node.func)[:-1])
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            names.add(node.args[0].value)
+    return names
+
+
+def default_checkers() -> list[Checker]:
+    return [
+        LockGuardChecker(),
+        ThreadHygieneChecker(),
+        TracePurityChecker(),
+        MetricNameChecker(),
+    ]

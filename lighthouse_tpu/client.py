@@ -132,11 +132,21 @@ class Client:
             from .crypto.bls.batch_verifier import ensure_running
 
             self.coalescer = ensure_running(ctx.bls)
-        self.processor = BeaconProcessor(coalescer=self.coalescer)
-        self.slasher = Slasher(ctx) if config.slasher_enabled else None
-        self.http: HttpApiServer | None = None
-        if config.http_enabled:
-            self.http = HttpApiServer(self.api, port=config.http_port).start()
+        try:
+            self.processor = BeaconProcessor(coalescer=self.coalescer)
+            self.slasher = Slasher(ctx) if config.slasher_enabled else None
+            self.http: HttpApiServer | None = None
+            if config.http_enabled:
+                self.http = HttpApiServer(self.api, port=config.http_port).start()
+        except BaseException:
+            # construction failed after the refcount was taken (e.g. the
+            # HTTP port is already bound): release it, or the process-wide
+            # coalescer threads outlive every Client forever
+            if self.coalescer is not None:
+                from .crypto.bls.batch_verifier import release
+
+                release(self.coalescer)
+            raise
 
     @staticmethod
     def _fetch_checkpoint_state(url: str, ctx):

@@ -307,6 +307,16 @@ GOSSIP_INTERNAL_ERRORS_TOTAL = REGISTRY.counter(
     "Frames dropped because OUR handler raised (not the peer's fault: the "
     "link is kept; a climbing rate means a local bug, not a bad peer)",
 )
+DISCOVERY_INTERNAL_ERRORS_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_discovery_internal_errors_total",
+    "Discovery datagrams dropped because OUR handler raised (the recv loop "
+    "keeps serving; a climbing rate means a local bug, not a hostile peer)",
+)
+BLS_COALESCER_INTERNAL_ERRORS_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_bls_coalescer_internal_errors_total",
+    "Coalescer resolver faults recovered by failing the affected futures "
+    "(a climbing rate means every verdict is quietly going False)",
+)
 
 # Labeled pipeline families (this file owns the cross-cutting ones; stage
 # histograms fed by tracing spans live in common/tracing.py, validator

@@ -298,7 +298,8 @@ class BatchVerifier:
                     pending.append(e)
                     npend += len(e.sets)
         finally:
-            self._running = False
+            with self._lock:
+                self._running = False
             # resolve anything still pending so no caller hangs, then let
             # the resolver drain its in-flight queue and exit
             for e in pending:
@@ -353,7 +354,12 @@ class BatchVerifier:
             entries, sets, fut, dispatched_at = item
             try:
                 self._resolve_one(entries, sets, fut, dispatched_at)
-            except Exception:  # noqa: BLE001 — never strand a future
+            except Exception:  # noqa: BLE001 — never strand a future, but
+                # COUNT the fault: a systematic resolver bug otherwise shows
+                # up only as every verdict quietly going False
+                from ...common.metrics import BLS_COALESCER_INTERNAL_ERRORS_TOTAL
+
+                BLS_COALESCER_INTERNAL_ERRORS_TOTAL.inc()
                 for e in entries:
                     if not e.future.done():
                         e.future._resolve([False] * len(e.sets))

@@ -42,39 +42,47 @@ def log2_distance(a: bytes, b: bytes) -> int:
 
 
 class RoutingTable:
-    """Fixed-size XOR-metric buckets (the discv5 crate's kbucket table)."""
+    """Fixed-size XOR-metric buckets (the discv5 crate's kbucket table).
+
+    Thread-safe: the recv loop learns records while API callers
+    (bootstrap/find_node) walk the table from their own threads."""
 
     def __init__(self, local_id: bytes):
         self.local_id = local_id
+        self._lock = threading.Lock()
         self.buckets: list[list[Enr]] = [[] for _ in range(N_BUCKETS + 1)]
 
     def insert(self, enr: Enr) -> bool:
         nid = enr.node_id()
         if nid == self.local_id:
             return False
-        bucket = self.buckets[log2_distance(self.local_id, nid)]
-        for i, existing in enumerate(bucket):
-            if existing.node_id() == nid:
-                if enr.seq > existing.seq:
-                    bucket[i] = enr  # newer record replaces
-                return True
-        if len(bucket) >= BUCKET_SIZE:
-            return False  # full bucket: drop (no eviction ping, noted)
-        bucket.append(enr)
-        return True
+        with self._lock:
+            bucket = self.buckets[log2_distance(self.local_id, nid)]
+            for i, existing in enumerate(bucket):
+                if existing.node_id() == nid:
+                    if enr.seq > existing.seq:
+                        bucket[i] = enr  # newer record replaces
+                    return True
+            if len(bucket) >= BUCKET_SIZE:
+                return False  # full bucket: drop (no eviction ping, noted)
+            bucket.append(enr)
+            return True
 
     def at_distance(self, distance: int) -> list[Enr]:
         if not 0 <= distance <= N_BUCKETS:
             return []
-        return list(self.buckets[distance])
+        with self._lock:
+            return list(self.buckets[distance])
 
     def closest(self, target_id: bytes, limit: int = BUCKET_SIZE) -> list[Enr]:
-        all_nodes = [e for b in self.buckets for e in b]
+        with self._lock:
+            all_nodes = [e for b in self.buckets for e in b]
         all_nodes.sort(key=lambda e: log2_distance(target_id, e.node_id()))
         return all_nodes[:limit]
 
     def __len__(self) -> int:
-        return sum(len(b) for b in self.buckets)
+        with self._lock:
+            return sum(len(b) for b in self.buckets)
 
 
 class DiscoveryService:
@@ -119,15 +127,24 @@ class DiscoveryService:
             try:
                 data, addr = self._sock.recvfrom(MAX_DATAGRAM)
             except OSError:
-                return
+                return  # socket closed: the service is shutting down
             try:
                 items = rlp_decode(data)
                 msg_type = items[0][0]
                 request_id = items[1]
                 payload = items[2:]
-                self._handle(addr, msg_type, request_id, payload)
             except (ValueError, IndexError):
-                continue  # malformed datagram drops
+                continue  # malformed datagram drops (the sender's fault)
+            try:
+                self._handle(addr, msg_type, request_id, payload)
+            except Exception:  # noqa: BLE001 — an INTERNAL fault (a bug in
+                # our own handler, a send failure) must not kill the recv
+                # loop and silently deafen discovery — COUNT it and keep
+                # serving (the narrowing gossip's _recv_loop got in PR 2)
+                from ..common.metrics import DISCOVERY_INTERNAL_ERRORS_TOTAL
+
+                DISCOVERY_INTERNAL_ERRORS_TOTAL.inc()
+                continue
 
     def _handle(self, addr, msg_type: int, request_id: bytes, payload: list) -> None:
         if msg_type == PING:

@@ -125,8 +125,12 @@ class GossipNode:
         # mcache: mid -> (topic, frame); _recent: ids to advertise via IHAVE
         self._mcache: OrderedDict[bytes, tuple[str, bytes]] = OrderedDict()
         self._recent: list[tuple[bytes, str]] = []
-        # IWANT promises: mid -> (peer socket, deadline)
-        self._promises: dict[bytes, tuple[socket.socket, float]] = {}
+        # IWANT promises: mid -> (peer socket, logical peer id, deadline).
+        # The id is captured at promise time: by expiry the peer may have
+        # disconnected (socket closed, _peer_ids entry gone), and the
+        # penalty must land on the LOGICAL id, not a phantom socket name —
+        # else cycling connections sheds broken-promise penalties.
+        self._promises: dict[bytes, tuple[socket.socket, str, float]] = {}
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -158,7 +162,8 @@ class GossipNode:
         # blocking recv() on an idle mesh would raise after 10 s and the
         # recv loop would reap a healthy peer
         sock.settimeout(None)
-        self._sock_dial_addr[sock] = addr
+        with self._peers_lock:
+            self._sock_dial_addr[sock] = addr
         self._add_peer(sock)
         return True
 
@@ -189,19 +194,27 @@ class GossipNode:
         threading.Thread(target=self._recv_loop, args=(sock,), daemon=True).start()
 
     def _drop_peer(self, sock: socket.socket) -> None:
-        pid = self._peer_id(sock)  # before the mapping is dropped below
         with self._peers_lock:
-            self._peers.pop(sock, None)
-            # drop the id mapping too: a stale entry would leak per
-            # reconnect and make report_invalid_message double-count
-            # on_disconnect against sockets long dead
-            self._peer_ids.pop(sock, None)
-            dialed = self._sock_dial_addr.pop(sock, None)
-            if dialed is not None:
-                self._dialed.discard(dialed)  # allow a future redial
-            for mesh in self._mesh.values():
-                mesh.discard(sock)
-        self.peer_db.on_disconnect(pid)
+            present = sock in self._peers
+            if present:
+                # resolve the pid BEFORE the mapping is dropped below
+                pid = self._peer_id(sock)
+                self._peers.pop(sock, None)
+                # drop the id mapping too: a stale entry would leak per
+                # reconnect and make report_invalid_message double-count
+                # on_disconnect against sockets long dead
+                self._peer_ids.pop(sock, None)
+                dialed = self._sock_dial_addr.pop(sock, None)
+                if dialed is not None:
+                    self._dialed.discard(dialed)  # allow a future redial
+                for mesh in self._mesh.values():
+                    mesh.discard(sock)
+        if present:
+            self.peer_db.on_disconnect(pid)
+        # not present: already dropped (a banned peer's dead socket gets
+        # re-dropped by its recv loop and by heartbeat ban checks) — the
+        # bookkeeping ran once, and resolving a pid NOW would fall back to
+        # a phantom 'sock-<id>' and mint a junk PeerRecord per re-drop
         try:
             sock.close()
         except OSError:
@@ -282,10 +295,13 @@ class GossipNode:
             if not isinstance(ctrl, dict):
                 raise ValueError("control frame must be an object")
             self._apply_control(ctrl, source)
-        except (ValueError, TypeError, AttributeError):
+        except (ValueError, TypeError, AttributeError, RecursionError):
             # hostile shapes anywhere in the structure ({"ihave": []},
-            # {"graft": 5}, non-hex ids, ...) are ONE violation, not a
-            # receiver-thread crash
+            # {"graft": 5}, non-hex ids, deeply-nested json bombs that
+            # overflow the parser's recursion, ...) are ONE violation, not
+            # a receiver-thread crash — and must reach the penalty path,
+            # not _recv_loop's internal-fault counter (a peer could feed
+            # that alarm at line rate for free)
             rec = self.peer_db.penalize(self._peer_id(source), PENALTY_PROTOCOL_VIOLATION)
             if rec.banned:
                 self._drop_peer(source)
@@ -340,6 +356,7 @@ class GossipNode:
                     if mid not in self._promises:
                         self._promises[mid] = (
                             source,
+                            self._peer_id(source),
                             time.monotonic() + IWANT_PROMISE_TTL,
                         )
                         wanted.append(h)
@@ -412,12 +429,12 @@ class GossipNode:
         now = time.monotonic()
         broken = []
         with self._peers_lock:
-            for mid, (peer, deadline) in list(self._promises.items()):
+            for mid, (peer, pid, deadline) in list(self._promises.items()):
                 if deadline < now:
                     del self._promises[mid]
-                    broken.append(peer)
-        for peer in broken:
-            rec = self.peer_db.penalize(self._peer_id(peer), PENALTY_BROKEN_PROMISE)
+                    broken.append((peer, pid))
+        for peer, pid in broken:
+            rec = self.peer_db.penalize(pid, PENALTY_BROKEN_PROMISE)
             if rec.banned:
                 self._drop_peer(peer)
 
@@ -426,8 +443,12 @@ class GossipNode:
             time.sleep(HEARTBEAT_INTERVAL)
             try:
                 self.heartbeat()
-            except Exception:  # noqa: BLE001 — heartbeat must never die
-                pass
+            except Exception:  # noqa: BLE001 — heartbeat must never die,
+                # but a silently-failing heartbeat means mesh maintenance
+                # and promise accounting have stopped: count it
+                from ..common.metrics import GOSSIP_INTERNAL_ERRORS_TOTAL
+
+                GOSSIP_INTERNAL_ERRORS_TOTAL.inc()
 
     # -- sending ---------------------------------------------------------------
 

@@ -32,27 +32,54 @@ PENALTY_RATE_LIMITED = 1.0
 
 @dataclass
 class PeerRecord:
+    """One peer's score book entry. Score decay and penalties are
+    read-modify-write sequences hit concurrently by every receiver thread
+    plus the gossip heartbeat, so each record carries its own lock; the
+    `*_locked` helpers are called with it held (the convention the
+    lock-guard analyzer enforces)."""
+
     peer_id: str
     score: float = 0.0
     connected: bool = False
     last_update: float = field(default_factory=time.monotonic)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False, compare=False)
 
-    def _decay(self) -> None:
+    def _decay_locked(self) -> None:
         now = time.monotonic()
         dt = now - self.last_update
         self.last_update = now
         if self.score < 0:
             self.score = min(0.0, self.score + dt * DECAY_PER_SECOND)
 
+    def penalize(self, amount: float) -> None:
+        with self._lock:
+            self._decay_locked()
+            self.score -= amount
+
+    def try_connect(self) -> bool:
+        """Atomically refuse-if-banned / mark-connected (peerdb.rs BanResult)."""
+        with self._lock:
+            self._decay_locked()
+            if self.score <= BAN_THRESHOLD:
+                return False
+            self.connected = True
+            return True
+
+    def mark_disconnected(self) -> None:
+        with self._lock:
+            self.connected = False
+
     @property
     def banned(self) -> bool:
-        self._decay()
-        return self.score <= BAN_THRESHOLD
+        with self._lock:
+            self._decay_locked()
+            return self.score <= BAN_THRESHOLD
 
     @property
     def graylisted(self) -> bool:
-        self._decay()
-        return self.score <= GRAYLIST_THRESHOLD
+        with self._lock:
+            self._decay_locked()
+            return self.score <= GRAYLIST_THRESHOLD
 
 
 class PeerDB:
@@ -71,20 +98,15 @@ class PeerDB:
 
     def penalize(self, peer_id: str, amount: float) -> PeerRecord:
         rec = self.record(peer_id)
-        rec._decay()
-        rec.score -= amount
+        rec.penalize(amount)
         return rec
 
     def on_connect(self, peer_id: str) -> bool:
         """False if the peer is banned (refuse the connection)."""
-        rec = self.record(peer_id)
-        if rec.banned:
-            return False
-        rec.connected = True
-        return True
+        return self.record(peer_id).try_connect()
 
     def on_disconnect(self, peer_id: str) -> None:
-        self.record(peer_id).connected = False
+        self.record(peer_id).mark_disconnected()
 
     def is_usable(self, peer_id: str) -> bool:
         return not self.record(peer_id).graylisted

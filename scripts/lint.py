@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Run the repo-native analyzers (lighthouse_tpu/analysis) over the tree.
+
+    python scripts/lint.py            # human-readable report
+    python scripts/lint.py --check    # CI gate: exit 1 on any unallowlisted
+                                      # finding or stale allowlist entry
+    python scripts/lint.py --json     # machine-readable findings
+    python scripts/lint.py network/   # lint a subset (paths relative to repo)
+
+Allowlist: scripts/lint_allowlist.txt — one `rule:path:symbol` per line,
+each with a mandatory `  # one-line justification`. Unjustified or stale
+entries fail the run: suppressions are reviewed code, not a dumping ground.
+
+Deliberately free of jax imports: the analyzers read source, they never
+execute it, so this runs in a few seconds anywhere (no device, no cache).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from lighthouse_tpu.analysis.engine import (  # noqa: E402
+    LintConfigError,
+    apply_allowlist,
+    load_allowlist,
+    run_lints,
+)
+from lighthouse_tpu.analysis.lints import default_checkers  # noqa: E402
+
+DEFAULT_PATHS = ["lighthouse_tpu"]
+ALLOWLIST = REPO_ROOT / "scripts" / "lint_allowlist.txt"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*", default=None, help="files/dirs (default: lighthouse_tpu)")
+    ap.add_argument("--check", action="store_true", help="exit 1 on unallowlisted findings")
+    ap.add_argument("--json", action="store_true", dest="as_json", help="JSON output")
+    ap.add_argument(
+        "--allowlist", default=str(ALLOWLIST), help="allowlist file (default: %(default)s)"
+    )
+    args = ap.parse_args(argv)
+
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        entries = load_allowlist(args.allowlist)
+        findings = run_lints(paths, default_checkers(), root=REPO_ROOT)
+        kept, suppressed, stale = apply_allowlist(findings, entries)
+    except LintConfigError as e:
+        print(f"lint configuration error: {e}", file=sys.stderr)
+        return 2
+
+    if args.as_json:
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in kept],
+                    "suppressed": [f.to_dict() for f in suppressed],
+                    "stale_allowlist_entries": [e.key for e in stale],
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in kept:
+            print(f.format())
+        for e in stale:
+            print(f"{args.allowlist}:{e.lineno}: stale allowlist entry {e.key!r} (matches nothing — delete it)")
+        print(
+            f"{len(kept)} finding(s), {len(suppressed)} suppressed, "
+            f"{len(stale)} stale allowlist entr(ies)"
+        )
+
+    if args.check and (kept or stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,3 +97,33 @@ def pytest_collection_modifyitems(config, items):
     for item in items:
         if "slow" in item.keywords:
             item.add_marker(skip)
+
+
+# -- opt-in runtime lock-order checking ----------------------------------------
+#
+# LIGHTHOUSE_TPU_LOCKCHECK=1 runs the threaded test modules under the
+# analysis/lockcheck detector: threading.Lock/RLock are wrapped per test, and
+# any lock-order cycle (potential deadlock) or BLS device dispatch performed
+# while holding a lock fails the test with both acquisition stacks. Off by
+# default — the wrappers add overhead and belong to the nightly/triage tier.
+
+_LOCKCHECK_MODULES = {"test_concurrency", "test_batch_verifier", "test_gossipsub"}
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck(request):
+    if os.environ.get("LIGHTHOUSE_TPU_LOCKCHECK") != "1":
+        yield
+        return
+    module = request.module.__name__.rpartition(".")[2]
+    if module not in _LOCKCHECK_MODULES:
+        yield
+        return
+    from lighthouse_tpu.analysis import lockcheck
+
+    lockcheck.install()
+    try:
+        yield
+    finally:
+        violations = lockcheck.uninstall()
+    assert not violations, "\n" + lockcheck.format_report(violations)

@@ -138,3 +138,30 @@ def test_named_network_and_testnet_dir(tmp_path):
                      http_enabled=False, interop_validators=8)
     )
     assert c2.ctx.spec.altair_fork_epoch == 3
+
+
+def test_ctor_failure_releases_coalescer_refcount():
+    """A Client that dies mid-construction (HTTP port already bound) must
+    release the process-wide coalescer reference it took, or the
+    collector/resolver threads leak for the life of the process."""
+    import socket
+
+    from lighthouse_tpu.crypto.bls import batch_verifier as bv
+
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    blocker.listen(1)
+    port = blocker.getsockname()[1]
+    try:
+        with pytest.raises(OSError):
+            Client(
+                ClientConfig(
+                    bls_backend="fake",
+                    coalesce_bls=True,  # force it: fake has no async path
+                    http_enabled=True,
+                    http_port=port,
+                )
+            )
+        assert bv._active is None  # the failed ctor dropped the last ref
+    finally:
+        blocker.close()

@@ -127,6 +127,42 @@ def test_graylisted_graft_gets_pruned():
         _close(nodes)
 
 
+def test_json_recursion_bomb_is_a_protocol_violation():
+    """A deeply-nested control frame overflows json's recursion — that is
+    the SENDER's hostility, so it must take the penalty path, not the
+    internal-error counter (which a peer could otherwise feed for free)."""
+    nodes, _ = _mesh_net(2)
+    a, b = nodes
+    try:
+        time.sleep(0.1)
+        peer_sock = next(iter(a._peers))
+        pid = a._peer_id(peer_sock)
+        bomb = b"\x01" + b"[" * 3000 + b"]" * 3000
+        before = a.peer_db.record(pid).score
+        a._on_control(bomb, peer_sock)
+        assert a.peer_db.record(pid).score < before
+    finally:
+        _close(nodes)
+
+
+def test_drop_peer_is_idempotent_no_phantom_records():
+    """A banned peer's socket gets dropped by _on_frame AND re-dropped by
+    its recv loop / heartbeat; the second drop must not resolve a phantom
+    'sock-<id>' peer id into a junk PeerRecord."""
+    nodes, _ = _mesh_net(2)
+    a, b = nodes
+    try:
+        time.sleep(0.1)
+        peer_sock = next(iter(a._peers))
+        a._drop_peer(peer_sock)
+        a._drop_peer(peer_sock)  # recv loop reaping the closed socket
+        a._drop_peer(peer_sock)  # heartbeat ban check on the dead socket
+        phantom = [p for p in a.peer_db._peers if p.startswith("sock-")]
+        assert not phantom, phantom
+    finally:
+        _close(nodes)
+
+
 def test_broken_iwant_promise_penalized():
     nodes, _ = _mesh_net(2)
     a, b = nodes
@@ -141,10 +177,25 @@ def test_broken_iwant_promise_penalized():
         assert a._promises
         # expire the promise
         mid = next(iter(a._promises))
-        peer, _deadline = a._promises[mid]
-        a._promises[mid] = (peer, time.monotonic() - 1)
+        peer, promised_pid, _deadline = a._promises[mid]
+        a._promises[mid] = (peer, promised_pid, time.monotonic() - 1)
         a.heartbeat()
         assert a.peer_db.record(pid).score < 0
+
+        # a peer that disconnects before expiry still pays on its LOGICAL
+        # id (the promise captured it; the socket alone would resolve to a
+        # phantom sock-<id> after close)
+        a._on_control(
+            encode_control({"ihave": {"t": ["cd" * 20]}}), peer_sock
+        )
+        mid2 = next(iter(a._promises))
+        p2, pid2, _d2 = a._promises[mid2]
+        assert pid2 == pid
+        a._drop_peer(peer_sock)
+        a._promises[mid2] = (p2, pid2, time.monotonic() - 1)
+        before = a.peer_db.record(pid).score
+        a.heartbeat()
+        assert a.peer_db.record(pid).score < before
     finally:
         _close(nodes)
 

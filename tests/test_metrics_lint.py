@@ -2,28 +2,43 @@
 must be `lighthouse_tpu_`-prefixed snake_case, so scrapes stay collision-
 free next to other exporters and dashboards can glob one prefix.
 
-Imports every module that registers metrics at import time, then audits
-the registry — a new module registering `my_counter` fails here, not in
-production Grafana.
+The convention lives in ONE place — analysis/lints.py's METRIC_NAME_RE /
+HISTOGRAM_UNIT_SUFFIXES, which the static metric-name checker enforces at
+lint time. This module audits the RUNTIME registry against those same
+constants (imports every module that registers at import time), and proves
+the static scan sees every family the runtime ends up holding — so the
+static checker and the runtime reality cannot drift apart.
 """
 
-import re
+import ast
+from pathlib import Path
 
-NAME_RE = re.compile(r"^lighthouse_tpu_[a-z0-9]+(_[a-z0-9]+)*$")
+from lighthouse_tpu.analysis.engine import iter_python_files
+from lighthouse_tpu.analysis.lints import (
+    HISTOGRAM_UNIT_SUFFIXES,
+    METRIC_NAME_RE,
+    registered_metric_names,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
 
 
-def test_registered_metric_names_are_prefixed_snake_case():
+def _import_registering_modules():
     # modules that register on REGISTRY at import time
     import lighthouse_tpu.chain.validator_monitor  # noqa: F401
     import lighthouse_tpu.common.metrics  # noqa: F401
     import lighthouse_tpu.common.tracing  # noqa: F401
     import lighthouse_tpu.crypto.bls.batch_verifier  # noqa: F401
     import lighthouse_tpu.validator_client.validator_client  # noqa: F401
+
+
+def test_registered_metric_names_are_prefixed_snake_case():
+    _import_registering_modules()
     from lighthouse_tpu.common.metrics import REGISTRY
 
     names = REGISTRY.names()
     assert names, "the global registry should not be empty"
-    bad = [n for n in names if not NAME_RE.fullmatch(n)]
+    bad = [n for n in names if not METRIC_NAME_RE.fullmatch(n)]
     assert not bad, f"metric names violating the lighthouse_tpu_ snake_case convention: {bad}"
 
 
@@ -41,13 +56,27 @@ def test_coalescer_metric_families_are_registered():
         "lighthouse_tpu_bls_bisection_batches_total",
         "lighthouse_tpu_bls_bisection_dispatches_total",
         "lighthouse_tpu_bls_bisection_blamed_sets_total",
+        "lighthouse_tpu_bls_coalescer_internal_errors_total",
+    ):
+        assert expected in names, f"missing metric family {expected}"
+
+
+def test_internal_error_counters_are_registered():
+    """The thread-hygiene lint lets a blanket except swallow a fault only
+    if it counts it — these are the counters those handlers feed."""
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    names = set(REGISTRY.names())
+    for expected in (
+        "lighthouse_tpu_gossip_internal_errors_total",
+        "lighthouse_tpu_discovery_internal_errors_total",
     ):
         assert expected in names, f"missing metric family {expected}"
 
 
 def test_histogram_families_use_unit_suffixes():
-    """Histograms carry a unit suffix (_seconds/_slots/_size/_bytes) — the
-    Prometheus naming convention the dashboards assume."""
+    """Histograms carry a unit suffix — the Prometheus naming convention
+    the dashboards assume, shared with the static checker."""
     from lighthouse_tpu.common.metrics import REGISTRY, Histogram, HistogramVec
 
     with REGISTRY._lock:
@@ -56,6 +85,19 @@ def test_histogram_families_use_unit_suffixes():
             for n, m in REGISTRY._metrics.items()
             if isinstance(m, (Histogram, HistogramVec))
         ]
-    allowed = ("_seconds", "_slots", "_size", "_bytes")
-    bad = [n for n in hists if not n.endswith(allowed)]
+    bad = [n for n in hists if not n.endswith(HISTOGRAM_UNIT_SUFFIXES)]
     assert not bad, f"histograms missing a unit suffix: {bad}"
+
+
+def test_static_scan_covers_runtime_registry():
+    """Every family the runtime registry holds must be visible to the
+    static metric-name checker as a literal registration — if someone
+    starts registering computed names, the lint goes blind and this fails."""
+    _import_registering_modules()
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    static_names: set[str] = set()
+    for f in iter_python_files(["lighthouse_tpu"], root=REPO_ROOT):
+        static_names |= registered_metric_names(ast.parse(f.read_text()))
+    missing = set(REGISTRY.names()) - static_names
+    assert not missing, f"runtime metric families invisible to the static checker: {missing}"

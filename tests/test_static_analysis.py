@@ -1,0 +1,489 @@
+"""The analyzer suite's own gate: every checker catches its seeded bug and
+stays quiet on the idiomatic pattern, the allowlist discipline is enforced,
+the runtime lock-order detector reports cycles with both acquisition stacks,
+and — the tier-1 teeth — the CURRENT TREE lints clean, so a future PR that
+mutates shared state off-lock or swallows thread faults fails here, not in
+an advisor round.
+"""
+
+from __future__ import annotations
+
+import ast
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu.analysis.engine import (
+    Finding,
+    LintConfigError,
+    apply_allowlist,
+    load_allowlist,
+    run_lints,
+)
+from lighthouse_tpu.analysis.lints import (
+    LockGuardChecker,
+    MetricNameChecker,
+    ThreadHygieneChecker,
+    TracePurityChecker,
+    default_checkers,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+def run_checker(checker, source: str) -> list[Finding]:
+    return checker.check(ast.parse(source), "fixture.py", source)
+
+
+# -- lock-guard ----------------------------------------------------------------
+
+LOCK_GUARD_BAD = """
+import threading
+
+class Mesh:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._peers = {}
+
+    def add(self, sock):
+        with self._lock:
+            self._peers[sock] = 1
+
+    def drop(self, sock):
+        self._peers.pop(sock, None)   # off-lock write: the gossip bug
+"""
+
+LOCK_GUARD_GOOD = """
+import threading
+
+class Mesh:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._peers = {}
+        self._epoch = 0               # written only in __init__: fine
+
+    def add(self, sock):
+        with self._lock:
+            self._peers[sock] = 1
+
+    def drop(self, sock):
+        with self._lock:
+            self._peers.pop(sock, None)
+
+    def _reap_locked(self, sock):
+        self._peers.pop(sock, None)   # *_locked: caller holds the lock
+"""
+
+
+def test_lock_guard_detects_off_lock_write():
+    findings = run_checker(LockGuardChecker(), LOCK_GUARD_BAD)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "lock-guard"
+    assert f.symbol == "Mesh._peers"
+    assert "without holding" in f.message
+
+
+def test_lock_guard_accepts_disciplined_class():
+    assert run_checker(LockGuardChecker(), LOCK_GUARD_GOOD) == []
+
+
+def test_lock_guard_sees_mutator_call_in_assignment():
+    # `x = self._d.pop(k)` is a write even though it isn't a bare Expr —
+    # exactly the shape of gossip._drop_peer's locked pop
+    src = LOCK_GUARD_BAD.replace(
+        "self._peers.pop(sock, None)   # off-lock write: the gossip bug",
+        "prev = self._peers.pop(sock, None)",
+    )
+    assert len(run_checker(LockGuardChecker(), src)) == 1
+
+
+def test_lock_guard_sees_mutation_in_compound_statement_header():
+    # `while self._q.pop():` mutates in the loop TEST, not a leaf statement
+    src = """
+import threading
+
+class Drainer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._q = []
+
+    def push(self, x):
+        with self._lock:
+            self._q.append(x)
+
+    def drain(self):
+        while self._q.pop():      # off-lock write in the while header
+            pass
+"""
+    findings = run_checker(LockGuardChecker(), src)
+    assert [f.symbol for f in findings] == ["Drainer._q"]
+
+
+def test_lock_guard_detects_dataclass_field_lock():
+    src = """
+import threading
+from dataclasses import dataclass, field
+
+@dataclass
+class Exec:
+    _lock: threading.Lock = field(default_factory=threading.Lock)
+    reason: str | None = None
+
+    def shutdown(self, reason):
+        with self._lock:
+            self.reason = reason
+
+    def force(self, reason):
+        self.reason = reason          # off-lock
+"""
+    findings = run_checker(LockGuardChecker(), src)
+    assert [f.symbol for f in findings] == ["Exec.reason"]
+
+
+# -- thread-hygiene ------------------------------------------------------------
+
+THREAD_BAD_SWALLOW = """
+import threading
+
+class Svc:
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            try:
+                self.step()
+            except Exception:
+                pass                  # swallow-and-continue: invisible faults
+"""
+
+THREAD_GOOD_COUNTED = """
+import threading
+
+class Svc:
+    def start(self):
+        threading.Thread(target=self._run, daemon=True).start()
+
+    def _run(self):
+        while True:
+            try:
+                self.step()
+            except ValueError:
+                continue              # narrowed: fine
+            except Exception:
+                ERRORS_TOTAL.inc()    # counted: fine
+"""
+
+THREAD_BAD_NO_JOIN = """
+import threading
+
+def launch(fn):
+    threading.Thread(target=fn).start()   # non-daemon, handle dropped
+"""
+
+THREAD_GOOD_JOINED = """
+import threading
+
+def launch(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+"""
+
+
+def test_thread_hygiene_detects_swallowed_blanket_except():
+    findings = run_checker(ThreadHygieneChecker(), THREAD_BAD_SWALLOW)
+    assert len(findings) == 1
+    assert findings[0].symbol == "Svc._run"
+    assert "blanket except" in findings[0].message
+
+
+def test_thread_hygiene_accepts_narrowed_and_counted():
+    assert run_checker(ThreadHygieneChecker(), THREAD_GOOD_COUNTED) == []
+
+
+def test_thread_hygiene_detects_unjoinable_nondaemon_thread():
+    findings = run_checker(ThreadHygieneChecker(), THREAD_BAD_NO_JOIN)
+    assert len(findings) == 1
+    assert "stop/join" in findings[0].message
+    assert run_checker(ThreadHygieneChecker(), THREAD_GOOD_JOINED) == []
+
+
+# -- trace-purity --------------------------------------------------------------
+
+TRACE_BAD = """
+import time
+import jax
+
+def _helper(x):
+    print("tracing", x)           # host side effect inside the trace
+    return x * 2
+
+def build():
+    def kernel(x):
+        t0 = time.time()          # host clock inside the trace
+        y = _helper(x)
+        return y, float(x)        # host sync on a traced argument
+    return jax.jit(kernel)
+"""
+
+TRACE_GOOD = """
+import time
+import jax
+import jax.numpy as jnp
+
+def stage(sets):
+    return time.monotonic(), sets   # host staging: NOT traced
+
+def build():
+    def kernel(x):
+        return jnp.sum(x * 2)
+    return jax.jit(kernel)
+"""
+
+
+def test_trace_purity_detects_impurities_transitively():
+    findings = run_checker(TracePurityChecker(), TRACE_BAD)
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.time" in msgs
+    assert "print()" in msgs
+    assert "float() on a traced argument" in msgs
+    assert {f.symbol for f in findings} == {"build.kernel", "_helper"}
+
+
+def test_trace_purity_ignores_host_staging():
+    assert run_checker(TracePurityChecker(), TRACE_GOOD) == []
+
+
+def test_trace_purity_detects_item_sync_in_decorated_fn():
+    src = """
+import jax
+
+@jax.jit
+def kernel(x):
+    return x.sum().item()
+"""
+    findings = run_checker(TracePurityChecker(), src)
+    assert len(findings) == 1 and ".item()" in findings[0].message
+
+
+# -- metric-name ---------------------------------------------------------------
+
+METRIC_BAD = """
+X = REGISTRY.counter("my_counter", "wrong prefix")
+H = REGISTRY.histogram("lighthouse_tpu_import_time", "missing unit suffix")
+"""
+
+METRIC_GOOD = """
+X = REGISTRY.counter("lighthouse_tpu_things_total", "fine")
+H = REGISTRY.histogram_vec("lighthouse_tpu_stage_seconds", "fine", ("stage",))
+"""
+
+
+def test_metric_name_detects_bad_registrations():
+    findings = run_checker(MetricNameChecker(), METRIC_BAD)
+    assert {f.symbol for f in findings} == {"my_counter", "lighthouse_tpu_import_time"}
+
+
+def test_metric_name_accepts_convention():
+    assert run_checker(MetricNameChecker(), METRIC_GOOD) == []
+
+
+# -- allowlist discipline ------------------------------------------------------
+
+
+def test_allowlist_requires_justification(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text("lock-guard:x.py:C.attr\n")
+    with pytest.raises(LintConfigError, match="justification"):
+        load_allowlist(p)
+
+
+def test_allowlist_suppresses_and_reports_stale(tmp_path):
+    p = tmp_path / "allow.txt"
+    p.write_text(
+        "lock-guard:x.py:C.attr  # single-writer flag, torn reads benign\n"
+        "lock-guard:gone.py:C.attr  # refers to deleted code\n"
+    )
+    entries = load_allowlist(p)
+    f = Finding(rule="lock-guard", path="x.py", line=3, symbol="C.attr", message="m")
+    kept, suppressed, stale = apply_allowlist([f], entries)
+    assert kept == [] and suppressed == [f]
+    assert [e.key for e in stale] == ["lock-guard:gone.py:C.attr"]
+
+
+# -- the tree gate (tier-1 teeth) ----------------------------------------------
+
+
+def test_repo_lints_clean():
+    """Zero unallowlisted findings over lighthouse_tpu/ — the invariant
+    every future PR inherits."""
+    entries = load_allowlist(REPO_ROOT / "scripts" / "lint_allowlist.txt")
+    findings = run_lints(["lighthouse_tpu"], default_checkers(), root=REPO_ROOT)
+    kept, _suppressed, stale = apply_allowlist(findings, entries)
+    assert not kept, "unallowlisted lint findings:\n" + "\n".join(f.format() for f in kept)
+    assert not stale, f"stale allowlist entries: {[e.key for e in stale]}"
+
+
+def test_lint_script_check_mode():
+    """`python scripts/lint.py --check` is the CI entry point; exit 0."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "lint.py"), "--check"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=REPO_ROOT,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- runtime lock-order detector -----------------------------------------------
+
+
+def test_lockcheck_reports_cycle_with_both_stacks():
+    """Two threads acquiring {A, B} in opposite orders: the order graph
+    gains A->B then B->A, and the cycle report carries BOTH acquisition
+    stacks (one per conflicting thread)."""
+    from lighthouse_tpu.analysis import lockcheck
+
+    det = lockcheck.install()
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+
+        def first_ab_order():
+            with lock_a:
+                with lock_b:
+                    pass
+
+        def second_ba_order():
+            with lock_b:
+                with lock_a:
+                    pass
+
+        t1 = threading.Thread(target=first_ab_order, name="t-ab")
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=second_ba_order, name="t-ba")
+        t2.start()
+        t2.join()
+    finally:
+        violations = lockcheck.uninstall()
+
+    cycles = [v for v in violations if v.kind == "lock-order-cycle"]
+    assert len(cycles) == 1
+    report = cycles[0].format()
+    # both threads' acquisition stacks are in the report
+    assert "first_ab_order" in report
+    assert "second_ba_order" in report
+    assert "t-ab" in report and "t-ba" in report
+
+
+def test_lockcheck_ignores_consistent_order_and_reentrancy():
+    from lighthouse_tpu.analysis import lockcheck
+
+    det = lockcheck.install()
+    try:
+        lock_a = threading.Lock()
+        lock_b = threading.Lock()
+        r = threading.RLock()
+        for _ in range(3):
+            with lock_a:
+                with lock_b:
+                    pass
+        with r:
+            with r:  # re-entry is not an ordering
+                pass
+    finally:
+        violations = lockcheck.uninstall()
+    assert violations == []
+
+
+def test_lockcheck_flags_device_dispatch_under_lock():
+    from lighthouse_tpu.analysis import lockcheck
+    from lighthouse_tpu.crypto.bls import fake
+
+    det = lockcheck.install()
+    try:
+        guard = threading.Lock()
+        with guard:
+            fake.verify_signature_sets([])  # device dispatch while holding
+        fake.verify_signature_sets([])  # lock released: fine
+    finally:
+        violations = lockcheck.uninstall()
+    assert [v.kind for v in violations] == ["dispatch-under-lock"]
+    assert "fake.verify_signature_sets" in violations[0].description
+
+
+def test_lockcheck_uninstall_restores_threading():
+    import _thread
+
+    from lighthouse_tpu.analysis import lockcheck
+
+    orig_lock, orig_rlock = threading.Lock, threading.RLock
+    lockcheck.install()
+    wrapped = threading.Lock()
+    assert isinstance(wrapped, lockcheck.InstrumentedLock)
+    lockcheck.uninstall()
+    assert threading.Lock is orig_lock and threading.RLock is orig_rlock
+    assert isinstance(threading.Lock(), _thread.LockType)
+    # a wrapper that outlived its detector still locks correctly
+    with wrapped:
+        assert wrapped.locked()
+    assert not wrapped.locked()
+
+
+def test_lockcheck_survives_factory_captured_while_installed():
+    """A reference to threading.Lock captured while patched (a dataclass
+    `field(default_factory=threading.Lock)` evaluated during an
+    instrumented test) must keep working after uninstall and re-instrument
+    on the next install."""
+    from lighthouse_tpu.analysis import lockcheck
+
+    lockcheck.install()
+    try:
+        captured = threading.Lock
+    finally:
+        lockcheck.uninstall()
+    plain = captured()  # detector gone: plain lock
+    with plain:
+        pass
+    assert not isinstance(plain, lockcheck.InstrumentedLock)
+    lockcheck.install()
+    try:
+        assert isinstance(captured(), lockcheck.InstrumentedLock)
+    finally:
+        lockcheck.uninstall()
+
+
+def test_lockcheck_instrumented_lock_works_under_queue_and_condition():
+    """The wrappers must not break stdlib users that consume
+    threading.Lock (queue.Queue builds a Condition over one)."""
+    import queue
+
+    from lighthouse_tpu.analysis import lockcheck
+
+    lockcheck.install()
+    try:
+        q = queue.Queue(maxsize=2)
+        q.put(1)
+        q.put(2)
+        assert q.get() == 1
+        assert q.get() == 2
+        results = []
+
+        def consumer():
+            results.append(q.get(timeout=5))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        q.put(42)
+        t.join(5)
+        assert results == [42]
+    finally:
+        violations = lockcheck.uninstall()
+    assert violations == []
