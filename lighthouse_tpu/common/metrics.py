@@ -314,8 +314,9 @@ DISCOVERY_INTERNAL_ERRORS_TOTAL = REGISTRY.counter(
 )
 BLS_COALESCER_INTERNAL_ERRORS_TOTAL = REGISTRY.counter(
     "lighthouse_tpu_bls_coalescer_internal_errors_total",
-    "Coalescer resolver faults recovered by failing the affected futures "
-    "(a climbing rate means every verdict is quietly going False)",
+    "Coalescer stager/resolver faults recovered by failing the affected "
+    "batches/futures (a climbing rate means every verdict is quietly "
+    "going False)",
 )
 
 # Labeled pipeline families (this file owns the cross-cutting ones; stage
@@ -341,6 +342,31 @@ BLS_BATCH_PADDED_SIZE = REGISTRY.histogram(
     "lighthouse_tpu_bls_batch_padded_size",
     "Padded set-count (S bucket) of each dispatched verify batch",
     buckets=(4, 8, 16, 32, 64, 128, 256, 512),
+)
+
+# Host staging fast path (stage_sets): per-point packed-limb caching and
+# hash-to-curve dedup/LRU. Labels: cache="pk_limbs" (G1 pubkey limb rows,
+# cached per validator lifetime via the PubkeyCache), cache="sig_limbs"
+# (G2 signature limb rows — pay off when bisection re-stages a failed
+# batch), cache="h2c" (hash_to_field rows per unique (message, dst);
+# intra-batch duplicates and LRU hits both count as hits).
+BLS_STAGING_CACHE_HITS_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_bls_staging_cache_hits_total",
+    "Staging-cache hits while packing device batches (rows gathered, not "
+    "recomputed)",
+    ("cache",),
+)
+BLS_STAGING_CACHE_MISSES_TOTAL = REGISTRY.counter_vec(
+    "lighthouse_tpu_bls_staging_cache_misses_total",
+    "Staging-cache misses while packing device batches (rows derived via "
+    "bigint arithmetic and cached)",
+    ("cache",),
+)
+BLS_STAGE_SECONDS = REGISTRY.histogram(
+    "lighthouse_tpu_bls_stage_seconds",
+    "Host staging wall time per batch (point packing + hash-to-field + "
+    "RLC scalar draw — everything before device dispatch)",
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0),
 )
 
 # Cross-caller batch coalescing (crypto/bls/batch_verifier.py): the

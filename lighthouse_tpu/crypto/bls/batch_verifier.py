@@ -23,10 +23,14 @@ Shape:
     flight — dispatch now rather than hoard), or (d) the service is
     kicked (`kick()`, e.g. by the BeaconProcessor when its drain ends and
     the device is about to idle) or stopping.
-  - Dispatch goes through `verify_signature_sets_async` when the backend
-    has it (the jax `VerifyFuture` path), so the collector stages and
-    submits batch i+1 while batch i executes on device — double-buffered
-    pipelining. A bounded in-flight queue (depth 2) provides backpressure.
+  - Formed batches hand off to a dedicated **staging thread**: the host
+    pre-processing (point packing, hash-to-field, RLC scalar draws) runs
+    there, off the collector's batch-formation loop, and dispatch goes
+    through `verify_signature_sets_async` when the backend has it (the
+    jax `VerifyFuture` path). Batch i+1 therefore packs and hashes on the
+    host while batch i executes on the device — the double-buffering that
+    previously covered only dispatch now covers staging too. Bounded
+    stage/in-flight queues (depth 2) provide backpressure.
   - An RLC batch verdict is all-or-nothing, so on batch failure a resolver
     thread **bisects**: split the failed batch, re-verify halves
     (pipelined when async is available), and recurse until every invalid
@@ -145,12 +149,14 @@ class BatchVerifier:
         self.max_wait = float(max_wait)
         self._rng = rng  # seeded-rng hook for deterministic tests
         self._queue: queue.Queue = queue.Queue()
+        self._stage_q: queue.Queue = queue.Queue(maxsize=IN_FLIGHT_DEPTH)
         self._resolve_q: queue.Queue = queue.Queue(maxsize=IN_FLIGHT_DEPTH)
         self._kick = threading.Event()
         self._lock = threading.Lock()
         self._in_flight = 0
         self._running = False
         self._collector: threading.Thread | None = None
+        self._stager: threading.Thread | None = None
         self._resolver: threading.Thread | None = None
         # observable totals (tests / bench read these; metrics mirror them)
         self.dispatches = 0
@@ -170,10 +176,14 @@ class BatchVerifier:
         self._collector = threading.Thread(
             target=self._collect_loop, name="bls-coalescer", daemon=True
         )
+        self._stager = threading.Thread(
+            target=self._stage_loop, name="bls-stager", daemon=True
+        )
         self._resolver = threading.Thread(
             target=self._resolve_loop, name="bls-resolver", daemon=True
         )
         self._collector.start()
+        self._stager.start()
         self._resolver.start()
         return self
 
@@ -185,6 +195,8 @@ class BatchVerifier:
         self._queue.put(None)  # wake the collector
         if self._collector is not None:
             self._collector.join(timeout)
+        if self._stager is not None:
+            self._stager.join(timeout)
         if self._resolver is not None:
             self._resolver.join(timeout)
 
@@ -311,16 +323,19 @@ class BatchVerifier:
                     break
                 if e is not None:
                     e.future._resolve(self._verify_direct(e.sets))
-            self._resolve_q.put(None)
+            self._stage_q.put(None)
 
     def _dispatch(self, entries: list[_Entry], n_sets: int) -> None:
+        """Hand a formed batch to the staging thread. The collector records
+        the coalescing metrics and goes straight back to batch formation;
+        packing/hashing happens on the stager so batch i+1 can form (and
+        then stage) while batch i executes on the device."""
         from ...common.metrics import (
             BLS_COALESCE_WAIT_SECONDS,
             BLS_COALESCED_BATCH_SIZE,
             BLS_COALESCED_DISPATCHES_TOTAL,
             BLS_SETS_TOTAL,
         )
-        from ...common.tracing import span
 
         now = time.monotonic()
         for e in entries:
@@ -333,16 +348,41 @@ class BatchVerifier:
         with self._lock:
             self._in_flight += 1
         sets = [s for e in entries for s in e.sets]
-        try:
-            # the staging spans (bls_pack/bls_h2c_host) nest under the same
-            # root the sync wrapper uses, so dashboards keep one stage tree
-            with span("bls_batch_verify"):
-                fut = self._call_async(sets)
-        except Exception:  # noqa: BLE001 — staging failure: bisect sorts it out
-            fut = _Ready(False)
-        # bounded put: with IN_FLIGHT_DEPTH batches outstanding this blocks,
-        # which is exactly the double-buffer backpressure we want
-        self._resolve_q.put((entries, sets, fut, now))
+        # bounded put: with IN_FLIGHT_DEPTH batches in the staging pipeline
+        # this blocks, which is exactly the backpressure we want; `now` rides
+        # along so BLS_BATCH_SECONDS covers formation-to-verdict including
+        # any wait in the stage queue (a pipeline stall must not be invisible
+        # to both latency histograms)
+        self._stage_q.put((entries, sets, now))
+
+    # -- stager: host staging off the dispatch critical path -------------------
+
+    def _stage_loop(self) -> None:
+        from ...common.tracing import span
+
+        while True:
+            item = self._stage_q.get()
+            if item is None:
+                self._resolve_q.put(None)
+                return
+            entries, sets, formed_at = item
+            try:
+                # the staging spans (bls_stage -> bls_pack/bls_h2c_host)
+                # nest under the same root the sync wrapper uses, so
+                # dashboards keep one stage tree; the async call returns as
+                # soon as the kernel is dispatched — the resolver owns the
+                # blocking wait, so this thread immediately stages the next
+                # batch while the device executes this one
+                with span("bls_batch_verify"):
+                    fut = self._call_async(sets)
+            except Exception:  # noqa: BLE001 — a staging fault fails the
+                # batch (bisection then assigns per-set blame), but COUNT
+                # it: a systematic staging bug must not be silent
+                from ...common.metrics import BLS_COALESCER_INTERNAL_ERRORS_TOTAL
+
+                BLS_COALESCER_INTERNAL_ERRORS_TOTAL.inc()
+                fut = _Ready(False)
+            self._resolve_q.put((entries, sets, fut, formed_at))
 
     # -- resolver: verdicts + bisection blame ----------------------------------
 
@@ -380,8 +420,9 @@ class BatchVerifier:
                 ok = bool(fut.result())
         except Exception:  # noqa: BLE001 — device/staging error == failed batch
             ok = False
-        # staging-to-verdict wall time: the coalesced counterpart of the
-        # sync wrapper's BLS_BATCH_SECONDS (staging + dispatch + fetch)
+        # formation-to-verdict wall time (stage-queue wait + staging +
+        # dispatch + fetch): the coalesced counterpart of the sync
+        # wrapper's BLS_BATCH_SECONDS
         BLS_BATCH_SECONDS.observe(max(0.0, time.monotonic() - dispatched_at))
         if ok:
             verdicts = [True] * len(sets)

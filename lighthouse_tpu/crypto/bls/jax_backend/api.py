@@ -329,15 +329,52 @@ _ONE_RNG = "ones"  # sentinel: r_i = 1 (single-set / aggregate-verify paths)
 
 
 def _scalar_bits(r: int) -> np.ndarray:
+    """Per-scalar slow path (tests assert _scalar_bits_batch against it)."""
     return np.array([(r >> (63 - i)) & 1 for i in range(64)], dtype=np.int32)
+
+
+def _scalar_bits_batch(rs) -> np.ndarray:
+    """Bulk `_scalar_bits`: (n, 64) int32 MSB-first bit rows. Big-endian
+    byte view + np.unpackbits replaces the n*64 Python shift loop."""
+    a = np.asarray(list(rs), dtype=">u8")
+    if a.size == 0:
+        return np.empty((0, 64), dtype=np.int32)
+    return np.unpackbits(a.view(np.uint8)).reshape(-1, 64).astype(np.int32)
+
+
+@lru_cache(maxsize=1)
+def _pad_generator() -> Point:
+    """One process-wide generator Point for S-bucket padding rows, so its
+    packed limb rows are computed once ever instead of once per staging."""
+    return _ref.g1_generator()
+
+
+def _batched_nonzero_scalars(n: int) -> np.ndarray:
+    """n independent nonzero 64-bit scalars from ONE entropy draw
+    (re-drawing any zeros), replacing n sequential secrets.randbits calls."""
+    out = np.frombuffer(secrets.token_bytes(8 * n), dtype=np.uint64).copy()
+    while True:
+        zeros = np.flatnonzero(out == 0)
+        if zeros.size == 0:
+            return out
+        out[zeros] = np.frombuffer(secrets.token_bytes(8 * zeros.size), dtype=np.uint64)
 
 
 def stage_sets(sets: list[SignatureSet], rng=None, s_floor: int = 4):
     """Host staging for the device kernels: pad the batch to the S bucket
     (pow2, >= s_floor) with (generator-keyed, r=0) no-op sets and each key
     list to the K bucket with infinity points (additive identity). Returns
-    (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits) numpy arrays."""
-    from ....common.metrics import BLS_BATCH_PADDED_SIZE
+    (pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits) numpy arrays.
+
+    This is the staging FAST path: point limb rows are gathered from the
+    per-point cache (pack.py) with misses bulk-converted, hash-to-field
+    runs once per unique message with an LRU in front (h2c.py), and the
+    RLC scalars are drawn/bit-expanded in one batched call. Output is
+    byte-identical to the per-element slow path (asserted in
+    tests/test_staging.py); the whole call is timed as the `bls_stage`
+    span / lighthouse_tpu_bls_stage_seconds."""
+    from ....common.metrics import BLS_BATCH_PADDED_SIZE, BLS_STAGE_SECONDS
+    from ....common.tracing import span
     from . import h2c
     from .pack import pack_g1_batch, pack_g2_batch
 
@@ -345,44 +382,90 @@ def stage_sets(sets: list[SignatureSet], rng=None, s_floor: int = 4):
     K = _next_pow2(max(len(s.signing_keys) for s in sets))
     BLS_BATCH_PADDED_SIZE.observe(S)
 
-    pk_pts: list[Point] = []
-    sig_pts: list[Point] = []
-    msgs: list[bytes] = []
-    r_rows = np.zeros((S, 64), dtype=np.int32)
-    gen = _ref.g1_generator()
-    for i in range(S):
-        if i < len(sets):
-            s = sets[i]
+    with BLS_STAGE_SECONDS.time(), span("bls_stage"):
+        n = len(sets)
+        pk_pts: list[Point] = []
+        sig_pts: list[Point] = []
+        msgs: list[bytes] = []
+        inf1 = g1_infinity()
+        for s in sets:
             keys = [pk.point for pk in s.signing_keys]
-            keys += [g1_infinity()] * (K - len(keys))
+            keys += [inf1] * (K - len(keys))
             pk_pts.extend(keys)
             sig_pts.append(s.signature.point)
             msgs.append(s.message)
+        if S > n:
+            gen = _pad_generator()
+            inf2 = g2_infinity()
+            for _ in range(S - n):
+                pk_pts.extend([gen] + [inf1] * (K - 1))
+                sig_pts.append(inf2)
+                msgs.append(b"")
+                # r stays 0: the padded set contributes the identity everywhere.
+
+        r_rows = np.zeros((S, 64), dtype=np.int32)
+        if n:
             if rng is _ONE_RNG:
-                r = 1
+                rs = [1] * n
+            elif rng is None:
+                rs = _batched_nonzero_scalars(n)
             else:
-                rand = rng if rng is not None else secrets.randbits
-                r = 0
-                while r == 0:
-                    r = rand(RAND_BITS)
-            r_rows[i] = _scalar_bits(r)
-        else:
-            pk_pts.extend([gen] + [g1_infinity()] * (K - 1))
-            sig_pts.append(g2_infinity())
-            msgs.append(b"")
-            # r stays 0: the padded set contributes the identity everywhere.
+                # seeded-rng seam: per-set draws in submission order, exactly
+                # like the slow path, so deterministic tests stay stable
+                rs = []
+                for _ in range(n):
+                    r = 0
+                    while r == 0:
+                        r = rng(RAND_BITS)
+                    rs.append(r)
+            r_rows[:n] = _scalar_bits_batch(rs)
 
-    from ....common.tracing import span
-
-    with span("bls_pack"):
-        pk_x, pk_y, pk_inf = pack_g1_batch(pk_pts)
-        pk_x = pk_x.reshape(S, K, -1)
-        pk_y = pk_y.reshape(S, K, -1)
-        pk_inf = pk_inf.reshape(S, K)
-        sig_x, sig_y, sig_inf = pack_g2_batch(sig_pts)
-    with span("bls_h2c_host"):
-        u = h2c.hash_to_field_limbs(msgs)
+        with span("bls_pack"):
+            pk_x, pk_y, pk_inf = pack_g1_batch(pk_pts)
+            pk_x = pk_x.reshape(S, K, -1)
+            pk_y = pk_y.reshape(S, K, -1)
+            pk_inf = pk_inf.reshape(S, K)
+            sig_x, sig_y, sig_inf = pack_g2_batch(sig_pts)
+        with span("bls_h2c_host"):
+            u = h2c.hash_to_field_limbs(msgs)
     return pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_rows
+
+
+def drop_staging_caches(sets) -> None:
+    """Bench/profiling/test helper: forget every staging cache a batch could
+    hit — the process-wide h2c LRU and the per-point limb rows of all
+    referenced points — so the next stage_sets runs fully cold. Keeping the
+    invalidation next to the caches stops the warm-vs-cold tools from
+    silently measuring a half-warm baseline when a cache is added."""
+    from . import h2c
+
+    h2c.H2C_FIELD_CACHE.clear()
+    try:
+        # the process-wide padding generator keeps its limb rows across
+        # batches; a padded "cold" measurement must not gather them
+        del _pad_generator()._limbs
+    except AttributeError:
+        pass
+    for s in sets:
+        for pk in s.signing_keys:
+            try:
+                del pk.point._limbs
+            except AttributeError:
+                pass
+        try:
+            del s.signature.point._limbs
+        except AttributeError:
+            pass
+
+
+def precompute_pubkey_limbs(pk: PublicKey) -> None:
+    """PubkeyCache hook (state_transition/context.py): attach the packed
+    limb rows to a freshly resolved validator pubkey so its first staged
+    batch is already a pk_limbs cache hit. Computed once per validator
+    lifetime — the rows live on the Point the cache retains."""
+    from .pack import precompute_limbs
+
+    precompute_limbs(pk.point)
 
 
 class VerifyFuture:

@@ -108,11 +108,98 @@ G2_GEN_X_L = pack_fp2(*G2_GENERATOR_X)
 G2_GEN_Y_L = pack_fp2(*G2_GENERATOR_Y)
 
 
+# -- batch packing with per-point limb-row caching -----------------------------
+#
+# The batch packers are the host staging hot path (stage_sets,
+# batch_validate_public_keys). Two levers keep them off the profile:
+#   - limb rows are memoized on the Point object itself (the `_limbs` slot):
+#     a validator pubkey held by the PubkeyCache is packed once per process
+#     lifetime, a signature re-staged by bisection is packed once per batch
+#     failure — later stagings GATHER the rows instead of re-deriving them.
+#   - cache misses are converted in ONE `fp.to_mont_host_bulk` call (the
+#     per-int Python shift/mask loop was ~10x the bigint mulmod cost).
+# Output is byte-identical to stacking the per-point pack_g1/pack_g2 results.
+
+def _count_staging_cache(cache: str, hits: int, misses: int) -> None:
+    from ....common.metrics import (
+        BLS_STAGING_CACHE_HITS_TOTAL,
+        BLS_STAGING_CACHE_MISSES_TOTAL,
+    )
+
+    if hits:
+        BLS_STAGING_CACHE_HITS_TOTAL.labels(cache=cache).inc(hits)
+    if misses:
+        BLS_STAGING_CACHE_MISSES_TOTAL.labels(cache=cache).inc(misses)
+
+
+def _pack_batch(pts, row_shape, coords_of, split_rows, label):
+    # preallocate and direct-assign rather than np.stack a row list — zeros
+    # double as the infinity rows, and stack's per-element introspection was
+    # the warm-path hotspot
+    xs = np.zeros((len(pts), *row_shape), dtype=np.int32)
+    ys = np.zeros_like(xs)
+    infs = np.zeros(len(pts), dtype=bool)
+    miss: dict[int, list[int]] = {}  # id(pt) -> positions (dedup in-batch)
+    hits = 0
+    for i, pt in enumerate(pts):
+        if pt.inf:
+            infs[i] = True
+            continue
+        rows = getattr(pt, "_limbs", None)
+        if rows is None:
+            miss.setdefault(id(pt), []).append(i)
+        else:
+            hits += 1
+            xs[i] = rows[0]
+            ys[i] = rows[1]
+    if miss:
+        coords: list[int] = []
+        for idxs in miss.values():
+            coords.extend(coords_of(pts[idxs[0]]))
+        limbs = fp.to_mont_host_bulk(coords)
+        for k, idxs in enumerate(miss.values()):
+            # copy out of the batch-sized bulk array: the rows live as long
+            # as the Point (a cached pubkey pins them for the process
+            # lifetime) and must not keep the whole batch's limbs alive
+            x_row, y_row = (r.copy() for r in split_rows(limbs, k))
+            x_row.setflags(write=False)
+            y_row.setflags(write=False)
+            pts[idxs[0]]._limbs = (x_row, y_row)
+            for i in idxs:
+                xs[i] = x_row
+                ys[i] = y_row
+        hits += sum(len(v) - 1 for v in miss.values())
+    _count_staging_cache(label, hits, len(miss))
+    return xs, ys, infs
+
+
 def pack_g1_batch(pts: list[Point]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    xs, ys, infs = zip(*(pack_g1(p) for p in pts))
-    return np.stack(xs), np.stack(ys), np.array(infs)
+    return _pack_batch(
+        pts,
+        (fp.N_LIMBS,),
+        lambda pt: (pt.x.n, pt.y.n),
+        lambda limbs, k: (limbs[2 * k], limbs[2 * k + 1]),
+        "pk_limbs",
+    )
 
 
 def pack_g2_batch(pts: list[Point]) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    xs, ys, infs = zip(*(pack_g2(p) for p in pts))
-    return np.stack(xs), np.stack(ys), np.array(infs)
+    return _pack_batch(
+        pts,
+        (2, fp.N_LIMBS),
+        lambda pt: (pt.x.c0.n, pt.x.c1.n, pt.y.c0.n, pt.y.c1.n),
+        lambda limbs, k: (limbs[4 * k : 4 * k + 2], limbs[4 * k + 2 : 4 * k + 4]),
+        "sig_limbs",
+    )
+
+
+def precompute_limbs(pt: Point) -> None:
+    """Eagerly attach a point's packed limb rows (no-op for infinity or an
+    already-warm point) — the PubkeyCache calls this at resolve time so the
+    first batch that references a validator is already a cache hit."""
+    if pt.inf or getattr(pt, "_limbs", None) is not None:
+        return
+    if isinstance(pt.x, Fp2):
+        pack_g2_batch([pt])
+    else:
+        pack_g1_batch([pt])

@@ -16,9 +16,16 @@ from .fields import Fp, Fp2
 
 
 class Point:
-    """Affine point on y^2 = x^3 + b over a generic field."""
+    """Affine point on y^2 = x^3 + b over a generic field.
 
-    __slots__ = ("x", "y", "inf", "b")
+    `_limbs` is an opaque staging-cache slot: the jax backend's host packer
+    (jax_backend/pack.py) memoizes the point's device limb rows here, so a
+    point packed once (a cached validator pubkey, a signature re-staged by
+    bisection) is gathered — not recomputed — on every later staging. It is
+    derived purely from (x, y), which are immutable after construction, so
+    it can never go stale. Left unset until first packed."""
+
+    __slots__ = ("x", "y", "inf", "b", "_limbs")
 
     def __init__(self, x, y, inf: bool, b):
         self.x, self.y, self.inf, self.b = x, y, inf, b

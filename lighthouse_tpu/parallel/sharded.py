@@ -77,13 +77,8 @@ def sharded_verify_signature_sets(sets, mesh: Mesh | None = None, rng=None) -> b
     mesh. Host staging is identical to the single-chip path."""
     from ..crypto.bls.jax_backend import api as japi
 
-    if not sets:
+    if not japi._structurally_valid(sets):
         return False
-    for s in sets:
-        if not s.signing_keys:
-            return False
-        if any(pk.point.inf for pk in s.signing_keys):
-            return False
 
     mesh = mesh or make_mesh()
     n = mesh.devices.size
@@ -96,7 +91,15 @@ _KERNELS: dict = {}
 
 
 def _kernel_cache(mesh: Mesh, S: int, K: int):
-    key = (id(mesh), S, K)
+    # Key on the mesh's CONTENT, not id(mesh): a GC'd mesh's id can be
+    # reused by a new mesh over different devices, which would serve a
+    # kernel compiled for (and sharded across) the wrong device set.
+    key = (
+        tuple(d.id for d in mesh.devices.flat),
+        mesh.axis_names,
+        S,
+        K,
+    )
     if key not in _KERNELS:
         _KERNELS[key] = build_sharded_verify(mesh)
     return _KERNELS[key]

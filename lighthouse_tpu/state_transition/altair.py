@@ -135,7 +135,16 @@ def get_next_sync_committee_indices(state, ctx: TransitionContext) -> list[int]:
 def get_next_sync_committee(state, ctx: TransitionContext):
     indices = get_next_sync_committee_indices(state, ctx)
     pubkey_bytes = [bytes(state.validators[i].pubkey) for i in indices]
-    pks = [ctx.bls.PublicKey.from_bytes(b) for b in pubkey_bytes]
+    # resolve through the PubkeyCache, not PublicKey.from_bytes directly:
+    # sync-committee rotation re-samples the same validators every period,
+    # and each direct decompression costs a Python bigint sqrt per key
+    resolve = ctx.pubkeys.resolver(state)
+    pks = []
+    for i in indices:
+        pk = resolve(i)
+        if pk is None:
+            raise StateTransitionError(f"undecodable pubkey for validator {i}")
+        pks.append(pk)
     aggregate = ctx.bls.aggregate_public_keys(pks)
     return ctx.types.SyncCommittee(
         pubkeys=pubkey_bytes, aggregate_pubkey=aggregate.to_bytes()

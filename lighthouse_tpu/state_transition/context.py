@@ -22,11 +22,20 @@ from ..types.containers import SpecTypes, mainnet_types, minimal_types
 
 
 class PubkeyCache:
-    """index -> decompressed backend PublicKey, memoized."""
+    """index -> decompressed backend PublicKey, memoized.
+
+    Backends that stage batches on an accelerator (the jax backend) expose
+    `precompute_pubkey_limbs`; the cache calls it on every admission so a
+    resolved key also carries its packed device limb rows — computed once
+    per validator lifetime and GATHERED (not re-derived) by `stage_sets`.
+    Staleness is impossible by construction: the cache keys on
+    (index, pubkey-bytes), so mutated pubkey bytes miss and decompress a
+    fresh point, and the limb rows live on the point object itself."""
 
     def __init__(self, bls_mod):
         self.bls = bls_mod
         self._cache: dict[tuple[int, bytes], Any] = {}
+        self._precompute = getattr(bls_mod, "precompute_pubkey_limbs", None)
 
     def resolver(self, state) -> Callable[[int], Any]:
         def resolve(index: int):
@@ -40,6 +49,8 @@ class PubkeyCache:
                     pk = self.bls.PublicKey.from_bytes(raw)
                 except self.bls.DecodeError:
                     return None
+                if self._precompute is not None:
+                    self._precompute(pk)
                 self._cache[key] = pk
             return pk
 

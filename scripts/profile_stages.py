@@ -18,6 +18,13 @@ Run: python scripts/profile_stages.py   (on the bench platform)
          (crypto/bls/batch_verifier.py) through the same span tracer:
          dispatch count vs caller count, coalesced batch sizes, waits.
          Env: PROFILE_COALESCE_CALLERS (64), PROFILE_COALESCE_ROUNDS (2).
+     python scripts/profile_stages.py --staging
+         host staging fast-path profile (stage_sets): cold caches vs warm
+         on a repeated-message batch, per-stage span breakdown
+         (bls_stage/bls_pack/bls_h2c_host) and the staging-cache hit/miss
+         counters a /metrics scrape would show. Host-only — no device
+         kernels run. Env: PROFILE_STAGING_SETS (64),
+         PROFILE_STAGING_MSGS (8), PROFILE_REPS (5).
 """
 
 import os
@@ -124,6 +131,74 @@ def coalesce_main() -> None:
               f"{BLS_COALESCE_WAIT_SECONDS.sum / BLS_COALESCE_WAIT_SECONDS.count * 1e3:9.2f} ms",
               flush=True)
     print(f"batch-size histogram n   {BLS_COALESCED_BATCH_SIZE.count}", flush=True)
+
+    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
+    for stage, rec in TRACER.stage_report().items():
+        print(
+            f"  {stage:22s} n={rec['count']:3d}"
+            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
+            f"  total={rec['total_s'] * 1e3:9.2f} ms",
+            flush=True,
+        )
+
+
+def staging_main() -> None:
+    """--staging: cold vs warm host staging through the span tracer and the
+    lighthouse_tpu_bls_staging_cache_{hits,misses}_total counters."""
+    import statistics as stats
+
+    from lighthouse_tpu.common.metrics import (
+        BLS_STAGE_SECONDS,
+        BLS_STAGING_CACHE_HITS_TOTAL,
+        BLS_STAGING_CACHE_MISSES_TOTAL,
+    )
+    from lighthouse_tpu.common.tracing import TRACER
+    from lighthouse_tpu.crypto import bls
+    from lighthouse_tpu.crypto.bls.jax_backend import api as japi
+
+    n_sets = int(os.environ.get("PROFILE_STAGING_SETS", "64"))
+    distinct = int(os.environ.get("PROFILE_STAGING_MSGS", "8"))
+    b = bls.backend("jax")
+    pairs = [b.interop_keypair(i) for i in range(n_sets)]
+    sets = []
+    for i, (sk, pk) in enumerate(pairs):
+        msg = bytes([i % distinct]) * 32
+        sets.append(b.SignatureSet(signature=sk.sign(msg), signing_keys=[pk], message=msg))
+    print(f"n_sets={n_sets} distinct_messages={distinct} (host-only profile)",
+          flush=True)
+
+    def counters():
+        out = {}
+        for cache in ("pk_limbs", "sig_limbs", "h2c"):
+            out[cache] = (
+                BLS_STAGING_CACHE_HITS_TOTAL.labels(cache=cache).value,
+                BLS_STAGING_CACHE_MISSES_TOTAL.labels(cache=cache).value,
+            )
+        return out
+
+    c0 = counters()
+    colds, warms = [], []
+    for _ in range(REPS):
+        japi.drop_staging_caches(sets)
+        t0 = time.perf_counter()
+        japi.stage_sets(sets)
+        colds.append(time.perf_counter() - t0)
+        japi.stage_sets(sets)  # fully warm
+        t0 = time.perf_counter()
+        japi.stage_sets(sets)
+        warms.append(time.perf_counter() - t0)
+    cold, warm = stats.median(colds), stats.median(warms)
+    c1 = counters()
+
+    print(f"cold stage_sets          {cold * 1e3:9.2f} ms", flush=True)
+    print(f"warm stage_sets          {warm * 1e3:9.2f} ms", flush=True)
+    print(f"warm/cold speedup        {cold / warm:9.2f} x", flush=True)
+    print(f"bls_stage histogram n    {BLS_STAGE_SECONDS.count}", flush=True)
+    print("\nstaging cache counters (this profile's delta):", flush=True)
+    for cache in ("pk_limbs", "sig_limbs", "h2c"):
+        dh = c1[cache][0] - c0[cache][0]
+        dm = c1[cache][1] - c0[cache][1]
+        print(f"  {cache:10s} hits={dh:8.0f}  misses={dm:8.0f}", flush=True)
 
     print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
     for stage, rec in TRACER.stage_report().items():
@@ -267,5 +342,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--coalesce" in sys.argv:
         coalesce_main()
+    elif "--staging" in sys.argv:
+        staging_main()
     else:
         main()

@@ -61,6 +61,21 @@ def test_coalescer_metric_families_are_registered():
         assert expected in names, f"missing metric family {expected}"
 
 
+def test_staging_metric_families_are_registered():
+    """The host-staging fast-path families (ISSUE 5) must exist on the
+    global registry under their contracted names."""
+    import lighthouse_tpu.common.metrics  # noqa: F401
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    names = set(REGISTRY.names())
+    for expected in (
+        "lighthouse_tpu_bls_staging_cache_hits_total",
+        "lighthouse_tpu_bls_staging_cache_misses_total",
+        "lighthouse_tpu_bls_stage_seconds",
+    ):
+        assert expected in names, f"missing metric family {expected}"
+
+
 def test_internal_error_counters_are_registered():
     """The thread-hygiene lint lets a blanket except swallow a fault only
     if it counts it — these are the counters those handlers feed."""

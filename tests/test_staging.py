@@ -1,0 +1,366 @@
+"""Host staging fast path (crypto/bls/jax_backend stage_sets + caches).
+
+The fast path's contract is BYTE-IDENTITY: packed-limb caching, hash-to-
+curve dedup/LRU and the vectorized bulk conversions must produce exactly
+the buffer the per-element slow path produced, cold caches or warm. These
+tests pin that contract (arrays compared with dtype + exact equality),
+prove the cache-hit/miss metrics move as designed, and prove stale limb
+rows cannot be served after a validator's pubkey bytes change.
+
+Everything here is host-side numpy work (no kernels compile), so the
+module runs in the fast tier; the device-verify parity check for a
+duplicated-message batch carries @pytest.mark.slow.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from lighthouse_tpu.crypto import bls
+from lighthouse_tpu.crypto.bls.constants import DST, G1_GENERATOR_X, P
+from lighthouse_tpu.crypto.bls.jax_backend import api as japi, fp, h2c, pack
+from lighthouse_tpu.crypto.bls.ref import hash_to_curve as ref_h2c
+
+
+def _chill(sets) -> None:
+    """Drop every staging cache a batch could hit: the h2c LRU and the
+    per-point limb rows of all referenced points."""
+    japi.drop_staging_caches(sets)
+
+
+@pytest.fixture(scope="module")
+def jax_bls():
+    return bls.backend("jax")
+
+
+@pytest.fixture(scope="module")
+def sets(jax_bls):
+    """11 sets: 8 single-key with 3 distinct messages (heavy message
+    duplication), one 3-key aggregate (K padding), S padded 11 -> 16."""
+    b = jax_bls
+    pairs = [b.interop_keypair(i) for i in range(8)]
+    out = []
+    for i in range(8):
+        sk, pk = pairs[i]
+        msg = bytes([i % 3]) * 32
+        out.append(b.SignatureSet(signature=sk.sign(msg), signing_keys=[pk], message=msg))
+    msg = b"\x07" * 32
+    agg = b.aggregate_signatures([sk.sign(msg) for sk, _ in pairs[:3]])
+    out.append(
+        b.SignatureSet(
+            signature=agg, signing_keys=[pk for _, pk in pairs[:3]], message=msg
+        )
+    )
+    sk, pk = pairs[5]
+    out.append(b.SignatureSet(signature=sk.sign(msg), signing_keys=[pk], message=msg))
+    sk, pk = pairs[6]
+    out.append(b.SignatureSet(signature=sk.sign(msg), signing_keys=[pk], message=msg))
+    return out
+
+
+# -- bulk conversion primitives == per-element slow path -----------------------
+
+
+def test_ints_to_limbs_matches_per_int():
+    rng = random.Random(0xBEEF)
+    xs = [0, 1, P - 1, (1 << 384) - 1] + [rng.randrange(1 << 384) for _ in range(20)]
+    bulk = fp.ints_to_limbs(xs)
+    ref = np.stack([fp.int_to_limbs(x) for x in xs])
+    assert bulk.dtype == ref.dtype == np.int32
+    assert np.array_equal(bulk, ref)
+    assert fp.ints_to_limbs([]).shape == (0, fp.N_LIMBS)
+    with pytest.raises(ValueError):
+        fp.ints_to_limbs([1 << 384])
+    with pytest.raises(ValueError):
+        fp.ints_to_limbs([-1])
+
+
+def test_to_mont_host_bulk_matches_per_int():
+    rng = random.Random(0xCAFE)
+    xs = [0, 1, P - 1] + [rng.randrange(P) for _ in range(8)]
+    bulk = fp.to_mont_host_bulk(xs)
+    ref = np.stack([fp.to_mont_host(x) for x in xs])
+    assert np.array_equal(bulk, ref)
+
+
+def test_scalar_bits_batch_matches_per_scalar():
+    rng = random.Random(0xD00D)
+    rs = [0, 1, 2**64 - 1, 0x8000000000000001] + [rng.getrandbits(64) for _ in range(16)]
+    bulk = japi._scalar_bits_batch(rs)
+    ref = np.stack([japi._scalar_bits(r) for r in rs])
+    assert bulk.dtype == ref.dtype == np.int32
+    assert np.array_equal(bulk, ref)
+
+
+def test_batched_nonzero_scalars_are_nonzero_64bit():
+    rs = japi._batched_nonzero_scalars(256)
+    assert rs.shape == (256,)
+    assert (rs != 0).all()
+    # and they round-trip through the bit expansion
+    bits = japi._scalar_bits_batch(rs)
+    assert bits.shape == (256, 64)
+    assert np.array_equal(bits[:, 0], (rs >> np.uint64(63)).astype(np.int32))
+
+
+# -- hash-to-curve dedup + LRU -------------------------------------------------
+
+
+def _h2c_row_slow(msg: bytes, dst: bytes) -> np.ndarray:
+    """The pre-dedup per-message computation, straight off the oracle."""
+    u0, u1 = ref_h2c.hash_to_field_fp2(msg, dst, 2)
+    row = np.empty((2, 2, fp.N_LIMBS), dtype=np.int32)
+    row[0, 0] = fp.to_mont_host(u0.c0.n)
+    row[0, 1] = fp.to_mont_host(u0.c1.n)
+    row[1, 0] = fp.to_mont_host(u1.c0.n)
+    row[1, 1] = fp.to_mont_host(u1.c1.n)
+    return row
+
+
+def test_hash_to_field_limbs_dedup_matches_slow_path():
+    msgs = [b"a" * 32, b"b" * 32, b"a" * 32, b"", b"b" * 32, b"a" * 32]
+    h2c.H2C_FIELD_CACHE.clear()
+    fast = h2c.hash_to_field_limbs(msgs)
+    slow = np.stack([_h2c_row_slow(m, DST) for m in msgs])
+    assert fast.dtype == slow.dtype == np.int32
+    assert np.array_equal(fast, slow)
+    # second call is served entirely from the LRU — still identical
+    again = h2c.hash_to_field_limbs(msgs)
+    assert np.array_equal(again, slow)
+    # distinct dst must not collide with the DST-keyed entries
+    other = h2c.hash_to_field_limbs([b"a" * 32], dst=b"other-dst")
+    assert not np.array_equal(other[0], slow[0])
+    assert np.array_equal(other[0], _h2c_row_slow(b"a" * 32, b"other-dst"))
+
+
+def test_h2c_lru_bounded():
+    cache = h2c._H2CFieldCache(maxsize=4)
+    for i in range(10):
+        cache.put((bytes([i]), DST), np.zeros((2, 2, fp.N_LIMBS), np.int32))
+    assert len(cache) == 4
+    assert cache.get((bytes([0]), DST)) is None  # evicted, oldest first
+    assert cache.get((bytes([9]), DST)) is not None
+
+
+# -- stage_sets: fast path byte-identical, warm or cold ------------------------
+
+
+def test_stage_sets_cached_vs_uncached_byte_identical(sets):
+    _chill(sets)
+    cold = japi.stage_sets(sets, rng=random.Random(42).getrandbits)
+    warm = japi.stage_sets(sets, rng=random.Random(42).getrandbits)
+    hot = japi.stage_sets(sets, rng=random.Random(42).getrandbits)
+    names = ("pk_x", "pk_y", "pk_inf", "sig_x", "sig_y", "sig_inf", "u", "r_bits")
+    for name, c, w, h in zip(names, cold, warm, hot):
+        assert c.dtype == w.dtype == h.dtype, name
+        assert np.array_equal(c, w), f"{name}: cold != warm"
+        assert np.array_equal(w, h), f"{name}: warm != hot"
+    # padding rows: sets 11..15 are (generator, r=0, empty-message) no-ops
+    pk_x, _, pk_inf, _, _, sig_inf, u, r_bits = cold
+    gen_x = pack.pack_fp(G1_GENERATOR_X)
+    for i in range(len(sets), 16):
+        assert np.array_equal(pk_x[i, 0], gen_x)
+        assert not pk_inf[i, 0] and pk_inf[i, 1:].all()
+        assert sig_inf[i]
+        assert (r_bits[i] == 0).all()
+        assert np.array_equal(u[i], _h2c_row_slow(b"", DST))
+
+
+def test_stage_sets_metrics_move_cold_to_warm(sets):
+    from lighthouse_tpu.common.metrics import (
+        BLS_STAGE_SECONDS,
+        BLS_STAGING_CACHE_HITS_TOTAL,
+        BLS_STAGING_CACHE_MISSES_TOTAL,
+    )
+
+    caches = ("pk_limbs", "sig_limbs", "h2c")
+
+    def snap():
+        return {
+            c: (
+                BLS_STAGING_CACHE_HITS_TOTAL.labels(cache=c).value,
+                BLS_STAGING_CACHE_MISSES_TOTAL.labels(cache=c).value,
+            )
+            for c in caches
+        }
+
+    _chill(sets)
+    n_stage = BLS_STAGE_SECONDS.count
+    before = snap()
+    japi.stage_sets(sets, rng=japi._ONE_RNG)
+    after_cold = snap()
+    japi.stage_sets(sets, rng=japi._ONE_RNG)
+    after_warm = snap()
+
+    for c in caches:
+        assert after_cold[c][1] > before[c][1], f"{c}: cold run must record misses"
+    # warm run: zero new misses, every gather a hit
+    for c in caches:
+        assert after_warm[c][1] == after_cold[c][1], f"{c}: warm run recorded misses"
+        assert after_warm[c][0] > after_cold[c][0], f"{c}: warm run recorded no hits"
+    # the duplicated messages dedup inside even the cold batch: 5 unique
+    # (3 distinct single-key msgs + the aggregate msg shared with sets
+    # 9/10 + the b"" padding msg) for 16 rows
+    cold_h2c_hits = after_cold["h2c"][0] - before["h2c"][0]
+    cold_h2c_miss = after_cold["h2c"][1] - before["h2c"][1]
+    assert cold_h2c_miss == 5
+    assert cold_h2c_hits == 11
+    assert BLS_STAGE_SECONDS.count == n_stage + 2  # every staging is timed
+
+
+def test_mutated_pubkey_bytes_cannot_serve_stale_limbs(jax_bls):
+    """The PubkeyCache keys on (index, pubkey-bytes): mutate a validator's
+    pubkey in the state and the resolver must hand back a fresh point whose
+    limb rows pack the NEW key — never the cached rows of the old one."""
+    from lighthouse_tpu.state_transition.context import PubkeyCache
+
+    b = jax_bls
+
+    class _Validator:
+        def __init__(self, pubkey):
+            self.pubkey = pubkey
+
+    class _State:
+        def __init__(self, pubkeys):
+            self.validators = [_Validator(pk) for pk in pubkeys]
+
+    _, pk_a = b.interop_keypair(100)
+    _, pk_b = b.interop_keypair(101)
+    state = _State([pk_a.to_bytes()])
+    cache = PubkeyCache(b)
+
+    first = cache.resolver(state)(0)
+    assert first is not None
+    rows_a = getattr(first.point, "_limbs", None)
+    assert rows_a is not None, "resolver must precompute limb rows (jax backend)"
+    assert np.array_equal(rows_a[0], pack.pack_fp(pk_a.point.x.n))
+
+    # memoized: same bytes -> same object, rows intact
+    assert cache.resolver(state)(0) is first
+
+    state.validators[0].pubkey = pk_b.to_bytes()
+    second = cache.resolver(state)(0)
+    assert second is not None and second is not first
+    rows_b = getattr(second.point, "_limbs", None)
+    assert rows_b is not None
+    assert np.array_equal(rows_b[0], pack.pack_fp(pk_b.point.x.n))
+    assert not np.array_equal(rows_b[0], rows_a[0])
+
+    # staging a set signed by the new key uses the new rows
+    staged = japi.stage_sets(
+        [b.SignatureSet(signature=b.Signature.infinity(), signing_keys=[second], message=b"m")],
+        rng=japi._ONE_RNG,
+    )
+    assert np.array_equal(staged[0][0, 0], pack.pack_fp(pk_b.point.x.n))
+
+
+def test_pubkey_cache_precompute_is_optional(jax_bls):
+    """Backends without the staging hook (ref/fake) resolve unchanged."""
+    from lighthouse_tpu.state_transition.context import PubkeyCache
+
+    r = bls.backend("ref")
+    assert PubkeyCache(r)._precompute is None
+    assert PubkeyCache(bls.backend("fake"))._precompute is None
+    assert PubkeyCache(jax_bls)._precompute is not None
+
+
+def test_sync_committee_resolution_goes_through_cache():
+    """altair.get_next_sync_committee must resolve pubkeys via the
+    PubkeyCache — the second rotation decompresses nothing."""
+    import dataclasses
+
+    from lighthouse_tpu.state_transition import TransitionContext, interop_genesis_state
+    from lighthouse_tpu.state_transition.altair import get_next_sync_committee
+    from lighthouse_tpu.types import MINIMAL_SPEC
+    from lighthouse_tpu.types.containers import minimal_types
+
+    ctx = TransitionContext(
+        minimal_types(),
+        dataclasses.replace(MINIMAL_SPEC, altair_fork_epoch=0),
+        bls.backend("fake"),
+    )
+    state = interop_genesis_state(8, 1_600_000_000, ctx)
+    committee = get_next_sync_committee(state, ctx)
+    assert len(ctx.pubkeys._cache) > 0, "committee resolution must populate the cache"
+
+    calls = {"n": 0}
+    orig = ctx.bls.PublicKey.from_bytes
+
+    def counting(data):
+        calls["n"] += 1
+        return orig(data)
+
+    ctx.bls.PublicKey.from_bytes = counting
+    try:
+        again = get_next_sync_committee(state, ctx)
+    finally:
+        ctx.bls.PublicKey.from_bytes = orig
+    assert calls["n"] == 0, "second rotation must be served from the PubkeyCache"
+    assert bytes(again.aggregate_pubkey) == bytes(committee.aggregate_pubkey)
+
+
+# -- the coalescer's staging stage ---------------------------------------------
+
+
+def test_stager_fault_fails_batch_and_counts(jax_bls):
+    """A backend whose async staging raises must still resolve every
+    future (all-False via bisection) and count the fault."""
+    from lighthouse_tpu.common.metrics import BLS_COALESCER_INTERNAL_ERRORS_TOTAL
+    from lighthouse_tpu.crypto.bls.batch_verifier import BatchVerifier
+
+    class ExplodingBackend:
+        def verify_signature_sets(self, sets, rng=None):
+            raise RuntimeError("boom")
+
+        def verify_signature_sets_async(self, sets, rng=None):
+            raise RuntimeError("boom")
+
+    e0 = BLS_COALESCER_INTERNAL_ERRORS_TOTAL.value
+    svc = BatchVerifier(ExplodingBackend(), max_wait=0.01).start()
+    try:
+        futs = [svc.submit([object()]) for _ in range(3)]
+        for f in futs:
+            assert f.result(timeout=10.0) == [False]
+    finally:
+        svc.stop()
+    assert BLS_COALESCER_INTERNAL_ERRORS_TOTAL.value > e0
+
+
+# -- device parity (slow tier) -------------------------------------------------
+
+
+@pytest.mark.slow
+def test_duplicated_message_batch_verifies_with_ref_parity(jax_bls, sets):
+    """The deduped staging path feeds the device kernel a batch with heavy
+    message duplication; the verdict must match the pure-Python oracle's,
+    valid and tampered."""
+    b = jax_bls
+    r = bls.backend("ref")
+
+    def to_ref(ss):
+        return [
+            r.SignatureSet(
+                signature=r.Signature(s.signature.point),
+                signing_keys=[r.PublicKey(pk.point) for pk in s.signing_keys],
+                message=s.message,
+            )
+            for s in ss
+        ]
+
+    _chill(sets)
+    subset = sets[:4]  # 2 distinct messages across 4 sets
+    seeded = random.Random(7).getrandbits
+    assert b.verify_signature_sets(subset, rng=seeded) is True
+    assert r.verify_signature_sets(to_ref(subset), rng=seeded) is True
+
+    tampered = subset[:3] + [
+        b.SignatureSet(
+            signature=subset[0].signature,
+            signing_keys=subset[1].signing_keys,
+            message=subset[0].message,
+        )
+    ]
+    assert b.verify_signature_sets(tampered, rng=seeded) is False
+    assert r.verify_signature_sets(to_ref(tampered), rng=seeded) is False
