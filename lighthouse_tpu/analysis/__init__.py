@@ -11,12 +11,23 @@ healthy peers); this package makes the whole class mechanical, so every
 future perf PR is gated by analyzers that encode the repo's threading
 and JAX-purity idioms.
 
-Two halves:
+Three layers:
 
   engine.py + lints.py   AST lint engine with four checkers (lock-guard,
-                         thread-hygiene, trace-purity, metric-name),
-                         driven by scripts/lint.py and gated in tier-1
-                         by tests/test_static_analysis.py.
+                         thread-hygiene, trace-purity incl. the 64-bit-
+                         dtype rule, metric-name), driven by
+                         scripts/lint.py and gated in tier-1 by
+                         tests/test_static_analysis.py.
+  jaxpr_lint.py          jaxpr-level kernel analyzer: traces every
+                         registered BLS kernel (crypto/bls/jax_backend/
+                         registry.py) and proves int32-overflow safety by
+                         interval abstract interpretation from the
+                         canonical-limb precondition, plus dtype/host-sync/
+                         unrolled-loop structure lints and primitive-count
+                         budgets vs scripts/jaxpr_budgets.json. Imports
+                         jax, so it is deliberately NOT imported here —
+                         scripts/lint.py loads it only under --jaxpr;
+                         tier-1 gate: tests/test_jaxpr_lint.py.
   lockcheck.py           opt-in runtime lock-order detector: instrumented
                          Lock/RLock wrappers record per-thread acquisition
                          edges into a global order graph; cycles (potential

@@ -1,0 +1,782 @@
+"""Jaxpr kernel analyzer: interval proofs of limb-overflow safety plus
+compile-cost and structure budgets.
+
+Why: the int32 limb scheme rests on a prose invariant — fp.py's docstring
+claims every schoolbook column sum is bounded by 32*(2^12)^2 = 2^29 and
+"fits int32 with headroom" — and ROADMAP item 1 rewrites exactly that
+arithmetic (windowed scalar mul, Karabina squaring, batch-affine), where a
+silent int32 wraparound is a verification-forgery bug that random-input
+differential tests can miss.  This module traces every registered kernel
+(crypto/bls/jax_backend/registry.py) to a closed jaxpr — trace-only, no
+compilation, so the gate is cheap on a CPU-only box — and proves/monitors
+four things, emitting engine.Finding objects through the same allowlist
+machinery as the AST lints:
+
+  jaxpr-interval   abstract interpretation with per-array integer ranges:
+                   [lo, hi] bounds propagate through every arithmetic and
+                   structural primitive and into scan/while/cond bodies
+                   (fixpoint with power-of-two widening), seeded from the
+                   canonical-limb precondition [0, 2^12).  An intermediate
+                   whose PROVEN range escapes its integer dtype is a
+                   finding carrying the offending eqn and its source
+                   provenance — the docstring bound becomes a theorem every
+                   kernel rewrite must re-prove.  Unhandled primitives are
+                   findings too (the analysis never silently passes).
+  jaxpr-dtype      64-bit avals (int64/uint64/float64 — WIDE_DTYPE_NAMES,
+                   single-sourced with lints.TracePurityChecker so the AST
+                   and jaxpr checks cannot drift) and float promotions
+                   inside integer-only kernels.  Under the x64 guard
+                   (jax_backend/__init__) these cannot appear in a default
+                   trace; the rule catches env drift and explicit wide
+                   inputs.
+  jaxpr-structure  host-sync/callback primitives under trace, and long
+                   repeated-eqn runs — an unrolled Python loop that should
+                   be a lax.scan (XLA compile time tracks inlined op count
+                   on this box).  Periods up to _MAX_PERIOD eqns are
+                   detected numerically; coarser unrolls surface as budget
+                   growth instead.
+  jaxpr-budget     flattened primitive counts per kernel against the
+                   committed baseline scripts/jaxpr_budgets.json.  Any
+                   unexplained growth fails; refresh deliberately with
+                   `python scripts/lint.py --update-budgets` (the diff of
+                   the baseline file is the explanation reviewers see).
+
+This module imports jax (unlike engine/lints) and is therefore NOT pulled
+in by `lighthouse_tpu.analysis.__init__`; scripts/lint.py imports it only
+under --jaxpr, keeping the default AST lint path dependency-free.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from pathlib import Path
+
+import numpy as np
+
+from .engine import Finding
+from .lints import WIDE_DTYPE_NAMES
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+BUDGETS_PATH = REPO_ROOT / "scripts" / "jaxpr_budgets.json"
+
+#: primitives that stall the device on the host (or smuggle host effects
+#: into traced code); never legal inside a BLS kernel
+HOST_SYNC_PRIMS = frozenset(
+    {
+        "pure_callback",
+        "io_callback",
+        "debug_callback",
+        "debug_print",
+        "infeed",
+        "outfeed",
+        "host_local_array_to_global_array",
+        "global_array_to_host_local_array",
+    }
+)
+
+# -- intervals -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Interval:
+    """Inclusive integer bounds for every element of an array (whole-array
+    abstraction: one [lo, hi] per value, exact Python ints so no analysis-
+    side overflow). `None` in the environment means unknown/tainted (floats,
+    unhandled primitives) — tainted values propagate without triggering
+    range findings; the taint source itself is always a finding."""
+
+    lo: int
+    hi: int
+
+
+def _join(a, b):
+    if a is None or b is None:
+        return None
+    return Interval(min(a.lo, b.lo), max(a.hi, b.hi))
+
+
+def _widen(iv: Interval) -> Interval:
+    """Power-of-two envelope: guarantees fixpoint termination in a few
+    iterations while staying far tighter than dtype bounds."""
+    hi = (1 << max(1, int(iv.hi).bit_length())) - 1 if iv.hi > 0 else iv.hi
+    lo = -(1 << max(1, int(-iv.lo).bit_length())) if iv.lo < 0 else iv.lo
+    return Interval(lo, hi)
+
+
+def _const_interval(val) -> Interval | None:
+    arr = np.asarray(val)
+    if arr.dtype.kind == "f":
+        return None
+    if arr.size == 0:
+        return Interval(0, 0)
+    return Interval(int(arr.min()), int(arr.max()))
+
+
+def _dtype_bounds(dtype) -> tuple[int, int] | None:
+    dt = np.dtype(dtype)
+    if dt.kind == "b":
+        return (0, 1)
+    if dt.kind in "iu":
+        info = np.iinfo(dt)
+        return (int(info.min), int(info.max))
+    return None
+
+
+# -- provenance ----------------------------------------------------------------
+
+
+def _eqn_provenance(eqn) -> tuple[str, int]:
+    """(repo-relative-or-absolute path, line) of the user frame that emitted
+    this eqn — the `source_info` thread from the original Python source."""
+    try:
+        from jax._src import source_info_util
+
+        frame = source_info_util.user_frame(eqn.source_info)
+        if frame is not None:
+            path = frame.file_name
+            try:
+                path = Path(path).resolve().relative_to(REPO_ROOT).as_posix()
+            except (ValueError, OSError):
+                pass
+            return path, int(frame.start_line)
+    except Exception:
+        pass
+    return "", 0
+
+
+def _spec_path(spec) -> str:
+    """Fallback Finding path: the kernel's defining module."""
+    import sys
+
+    mod = sys.modules.get(spec.module)
+    f = getattr(mod, "__file__", None)
+    if f:
+        try:
+            return Path(f).resolve().relative_to(REPO_ROOT).as_posix()
+        except (ValueError, OSError):
+            return Path(f).as_posix()
+    return spec.module.replace(".", "/") + ".py"
+
+
+# -- sub-jaxpr plumbing --------------------------------------------------------
+
+
+def _as_closed(obj):
+    """Normalize a params value to (jaxpr, consts) if it wraps a jaxpr."""
+    jaxpr = getattr(obj, "jaxpr", None)
+    if jaxpr is not None and hasattr(obj, "consts"):
+        return jaxpr, list(obj.consts)
+    if hasattr(obj, "eqns") and hasattr(obj, "invars"):
+        return obj, []
+    return None
+
+
+def _param_jaxprs(eqn):
+    """Every (jaxpr, consts) nested in an eqn's params, any wrapping."""
+    out = []
+    for v in eqn.params.values():
+        for item in v if isinstance(v, (tuple, list)) else (v,):
+            got = _as_closed(item)
+            if got is not None:
+                out.append(got)
+    return out
+
+
+def _iter_jaxprs(jaxpr):
+    """The jaxpr and every nested sub-jaxpr (each body yielded once)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for sub, _consts in _param_jaxprs(eqn):
+            yield from _iter_jaxprs(sub)
+
+
+def count_primitives(closed) -> dict:
+    """Flattened primitive counts: every eqn in every nested jaxpr counted
+    once (a scan body counts once — what the compiler ingests, and the
+    number tracing/compile time actually tracks on this box)."""
+    by_prim: dict[str, int] = {}
+    for j in _iter_jaxprs(closed.jaxpr):
+        for eqn in j.eqns:
+            by_prim[eqn.primitive.name] = by_prim.get(eqn.primitive.name, 0) + 1
+    return {"eqns": sum(by_prim.values()), "by_prim": dict(sorted(by_prim.items()))}
+
+
+# -- the interval abstract interpreter -----------------------------------------
+
+_SCAN_MAX_ITERS = 24
+_SCAN_WIDEN_AFTER = 3
+
+
+class _Ctx:
+    """Per-kernel analysis state. `emit` gates finding emission so scan/while
+    fixpoint iterations stay silent; the converged final pass reports."""
+
+    def __init__(self, spec):
+        self.spec = spec
+        self.emit = True
+        self.findings: list[Finding] = []
+        self._seen: set[tuple] = set()
+
+    def finding(self, rule: str, eqn, message: str) -> None:
+        if not self.emit:
+            return
+        path, line = _eqn_provenance(eqn)
+        # one finding per (rule, source line): a shared helper inlined many
+        # times (fp.mul inside a composite) reports once, not per inlining
+        key = (rule, path, line)
+        if key in self._seen:
+            return
+        self._seen.add(key)
+        self.findings.append(
+            Finding(
+                rule=rule,
+                path=path or _spec_path(self.spec),
+                line=line,
+                symbol=self.spec.name,
+                message=message,
+            )
+        )
+
+
+def _corners(a: Interval, b: Interval, op) -> Interval:
+    vals = (op(a.lo, b.lo), op(a.lo, b.hi), op(a.hi, b.lo), op(a.hi, b.hi))
+    return Interval(min(vals), max(vals))
+
+
+def _shift_corners(a: Interval, s: Interval, op) -> Interval:
+    s_lo, s_hi = max(0, s.lo), max(0, min(s.hi, 64))
+    vals = (op(a.lo, s_lo), op(a.lo, s_hi), op(a.hi, s_lo), op(a.hi, s_hi))
+    return Interval(min(vals), max(vals))
+
+
+def _reduced_count(eqn) -> int:
+    """Number of elements folded into one output element by a reduce."""
+    in_shape = eqn.invars[0].aval.shape
+    axes = eqn.params.get("axes", ())
+    n = 1
+    for ax in axes:
+        n *= int(in_shape[ax])
+    return max(1, n)
+
+
+def _transfer(eqn, ins, ctx) -> list:
+    """Per-primitive interval transfer. Returns one Interval/None per
+    outvar. Pure integer math on Python ints — the analysis itself cannot
+    overflow."""
+    name = eqn.primitive.name
+    a = ins[0] if ins else None
+    b = ins[1] if len(ins) > 1 else None
+
+    if name in HOST_SYNC_PRIMS:
+        # already a jaxpr-structure finding; don't double-report as unhandled
+        return [None] * len(eqn.outvars)
+
+    # structural pass-throughs (value set preserved or shrunk)
+    if name in (
+        "broadcast_in_dim", "reshape", "transpose", "squeeze", "rev", "copy",
+        "device_put", "stop_gradient", "slice", "gather", "real", "expand_dims",
+        "reduce_max", "reduce_min", "reduce_precision", "convert_element_type",
+        "optimization_barrier",
+    ):
+        if name == "convert_element_type":
+            new = eqn.params.get("new_dtype")
+            if new is not None and np.dtype(new).kind == "b":
+                return [Interval(0, 1) if a is not None else None]
+        if name == "optimization_barrier":
+            return list(ins)
+        return [a]
+    if name in ("dynamic_slice",):
+        return [a]
+    if name in ("concatenate",):
+        out = ins[0]
+        for x in ins[1:]:
+            out = _join(out, x)
+        return [out]
+    if name == "pad":
+        return [_join(a, b)]
+    if name == "dynamic_update_slice":
+        return [_join(a, ins[1])]  # (operand, update, *start_indices)
+    if name in ("scatter", "select_and_scatter_add"):
+        return [_join(a, ins[2] if len(ins) > 2 else b)]  # (operand, idx, updates)
+    if name == "scatter-add":
+        if a is None or ins[2] is None:
+            return [None]
+        upd = ins[2]
+        return [Interval(a.lo + min(0, upd.lo), a.hi + max(0, upd.hi))]
+    if name == "select_n":
+        out = ins[1]
+        for x in ins[2:]:
+            out = _join(out, x)
+        return [out]
+    if name == "clamp":
+        lo_i, x, hi_i = ins
+        if lo_i is None or x is None or hi_i is None:
+            return [None]
+        return [Interval(max(lo_i.lo, min(x.lo, hi_i.hi)), min(hi_i.hi, max(x.hi, lo_i.lo)))]
+    if name == "iota":
+        dim = eqn.params.get("dimension", 0)
+        shape = eqn.params.get("shape", (1,))
+        return [Interval(0, max(0, int(shape[dim]) - 1))]
+
+    # comparisons / predicates
+    if name in ("eq", "ne", "lt", "le", "gt", "ge", "is_finite"):
+        return [Interval(0, 1)]
+    if name in ("reduce_and", "reduce_or"):
+        return [Interval(0, 1)]
+
+    # control flow (before the taint guard: bodies are analyzed even when
+    # some operand is tainted, so findings inside them still surface)
+    if name in (
+        "pjit", "closed_call", "core_call", "xla_call", "remat", "checkpoint",
+        "custom_jvp_call", "custom_vjp_call", "custom_jvp_call_jaxpr",
+    ):
+        for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+            obj = eqn.params.get(key)
+            got = _as_closed(obj) if obj is not None else None
+            if got is not None:
+                sub, consts = got
+                return _interp(sub, consts, list(ins), ctx)
+        return None  # fall through to unhandled
+    if name == "scan":
+        return _scan_transfer(eqn, ins, ctx)
+    if name == "while":
+        return _while_transfer(eqn, ins, ctx)
+    if name == "cond":
+        branches = eqn.params["branches"]
+        outs = None
+        for br in branches:
+            sub, consts = _as_closed(br)
+            res = _interp(sub, consts, list(ins[1:]), ctx)
+            outs = res if outs is None else [_join(x, y) for x, y in zip(outs, res)]
+        return outs
+
+    # arithmetic
+    if any(x is None for x in ins) and name not in ("and", "or", "xor", "not"):
+        return [None] * len(eqn.outvars)
+    if name == "add":
+        return [Interval(a.lo + b.lo, a.hi + b.hi)]
+    if name == "sub":
+        return [Interval(a.lo - b.hi, a.hi - b.lo)]
+    if name == "mul":
+        return [_corners(a, b, lambda x, y: x * y)]
+    if name == "neg":
+        return [Interval(-a.hi, -a.lo)]
+    if name == "abs":
+        lo = 0 if a.lo <= 0 <= a.hi else min(abs(a.lo), abs(a.hi))
+        return [Interval(lo, max(abs(a.lo), abs(a.hi)))]
+    if name == "sign":
+        return [Interval(-1 if a.lo < 0 else (1 if a.lo > 0 else 0),
+                         1 if a.hi > 0 else (-1 if a.hi < 0 else 0))]
+    if name in ("max",):
+        return [Interval(max(a.lo, b.lo), max(a.hi, b.hi))]
+    if name in ("min",):
+        return [Interval(min(a.lo, b.lo), min(a.hi, b.hi))]
+    if name == "shift_right_arithmetic":
+        return [_shift_corners(a, b, lambda x, s: x >> s)]
+    if name == "shift_right_logical":
+        if a.lo >= 0:
+            return [_shift_corners(a, b, lambda x, s: x >> s)]
+        bounds = _dtype_bounds(eqn.outvars[0].aval.dtype) or (0, 1)
+        return [Interval(0, max(a.hi, bounds[1]))]
+    if name == "shift_left":
+        return [_shift_corners(a, b, lambda x, s: x << s)]
+    if name in ("and", "or", "xor"):
+        dt = np.dtype(eqn.outvars[0].aval.dtype)
+        if dt.kind == "b":
+            return [Interval(0, 1)]
+        if a is None or b is None:
+            return [None]
+        if name == "and":
+            nonneg = [x.hi for x in (a, b) if x.lo >= 0]
+            if nonneg:
+                return [Interval(0, min(nonneg))]
+        elif a.lo >= 0 and b.lo >= 0:
+            m = max(a.hi, b.hi)
+            return [Interval(0, (1 << max(1, int(m).bit_length())) - 1)]
+        bounds = _dtype_bounds(dt)
+        return [Interval(*bounds) if bounds else None]
+    if name == "not":
+        dt = np.dtype(eqn.outvars[0].aval.dtype)
+        if dt.kind == "b":
+            return [Interval(0, 1)]
+        if a is None:
+            return [None]
+        return [Interval(-a.hi - 1, -a.lo - 1)]
+    if name == "reduce_sum":
+        n = _reduced_count(eqn)
+        return [Interval(a.lo * n, a.hi * n)]
+    if name == "reduce_prod":
+        n = _reduced_count(eqn)
+        m = max(abs(a.lo), abs(a.hi), 1)
+        return [Interval(-(m**n), m**n)]
+    if name == "integer_pow":
+        y = int(eqn.params.get("y", 1))
+        if y < 0:
+            return [None]
+        cands = [a.lo**y, a.hi**y]
+        if a.lo < 0 < a.hi:
+            cands.append(0)
+        return [Interval(min(cands), max(cands))]
+    if name == "rem":
+        m = max(abs(b.lo), abs(b.hi), 1)
+        return [Interval(max(a.lo, -(m - 1)) if a.lo < 0 else 0, min(a.hi, m - 1) if a.hi > 0 else 0)]
+    if name == "div":
+        # conservative: |quotient| <= |dividend| for |divisor| >= 1, and the
+        # quotient's sign set is covered by the dividend/divisor corners
+        m = max(abs(a.lo), abs(a.hi))
+        return [Interval(-m, m)]
+    if name == "dot_general":
+        dims = eqn.params["dimension_numbers"]
+        (lhs_c, _rhs_c), _ = dims
+        n = 1
+        for ax in lhs_c:
+            n *= int(eqn.invars[0].aval.shape[ax])
+        prod = _corners(a, b, lambda x, y: x * y)
+        return [Interval(prod.lo * max(1, n), prod.hi * max(1, n))]
+
+    return None  # unhandled
+
+
+def _fixpoint_carry(run_body, init, ctx):
+    """Shared scan/while carry fixpoint with widening; returns converged
+    carry intervals. `run_body(carry) -> new_carry` must be silent."""
+    carry = list(init)
+    emit_was = ctx.emit
+    ctx.emit = False
+    try:
+        for it in range(_SCAN_MAX_ITERS):
+            new = run_body(carry)
+            joined = [_join(c, n) for c, n in zip(carry, new)]
+            if it >= _SCAN_WIDEN_AFTER:
+                joined = [
+                    (_widen(j) if j is not None and j != c else j)
+                    for j, c in zip(joined, carry)
+                ]
+            if joined == carry:
+                return carry
+            carry = joined
+    finally:
+        ctx.emit = emit_was
+    return [None] * len(carry)  # did not converge: taint
+
+
+def _scan_transfer(eqn, ins, ctx):
+    p = eqn.params
+    sub, consts = _as_closed(p["jaxpr"])
+    nc, ncar = p["num_consts"], p["num_carry"]
+    sc_consts, init, xs = ins[:nc], ins[nc : nc + ncar], ins[nc + ncar :]
+
+    def run_body(carry):
+        outs = _interp(sub, consts, list(sc_consts) + list(carry) + list(xs), ctx)
+        return outs[:ncar]
+
+    carry = _fixpoint_carry(run_body, init, ctx)
+    outs = _interp(sub, consts, list(sc_consts) + list(carry) + list(xs), ctx)
+    return list(carry) + outs[ncar:]  # final carries + stacked ys
+
+
+def _while_transfer(eqn, ins, ctx):
+    p = eqn.params
+    cond, cond_consts = _as_closed(p["cond_jaxpr"])
+    body, body_consts = _as_closed(p["body_jaxpr"])
+    cn, bn = p["cond_nconsts"], p["body_nconsts"]
+    c_consts, w_consts, init = ins[:cn], ins[cn : cn + bn], ins[cn + bn :]
+
+    def run_body(carry):
+        return _interp(body, body_consts, list(w_consts) + list(carry), ctx)
+
+    carry = _fixpoint_carry(run_body, init, ctx)
+    # emit passes over BOTH sub-jaxprs: the termination test runs on-device
+    # with the same carry values, so an overflow there wraps just as hard
+    _interp(cond, cond_consts, list(c_consts) + list(carry), ctx)
+    _interp(body, body_consts, list(w_consts) + list(carry), ctx)
+    return carry
+
+
+def _interp(jaxpr, consts, in_ivals, ctx) -> list:
+    """Interpret one jaxpr level over intervals, checking every integer
+    output against its dtype bounds."""
+    env: dict = {}
+
+    def read(atom):
+        if hasattr(atom, "val"):  # Literal
+            return _const_interval(atom.val)
+        return env.get(atom)
+
+    for var, const in zip(jaxpr.constvars, consts):
+        env[var] = _const_interval(const)
+    for var, iv in zip(jaxpr.invars, in_ivals):
+        env[var] = iv
+
+    for eqn in jaxpr.eqns:
+        ins = [read(x) for x in eqn.invars]
+        outs = _transfer(eqn, ins, ctx)
+        if outs is None:
+            if all(np.dtype(v.aval.dtype).kind == "f" for v in eqn.outvars):
+                outs = [None] * len(eqn.outvars)  # float graph: dtype lint owns it
+            else:
+                ctx.finding(
+                    "jaxpr-interval",
+                    eqn,
+                    f"unhandled primitive '{eqn.primitive.name}': interval "
+                    f"analysis cannot bound its output — extend "
+                    f"analysis/jaxpr_lint._transfer",
+                )
+                outs = [None] * len(eqn.outvars)
+        for var, iv in zip(eqn.outvars, outs):
+            if iv is not None:
+                bounds = _dtype_bounds(var.aval.dtype)
+                if bounds is not None:
+                    lo, hi = bounds
+                    if iv.lo < lo or iv.hi > hi:
+                        ctx.finding(
+                            "jaxpr-interval",
+                            eqn,
+                            f"proven value range [{iv.lo}, {iv.hi}] of "
+                            f"'{eqn.primitive.name}' output exceeds "
+                            f"{np.dtype(var.aval.dtype).name} [{lo}, {hi}] "
+                            f"— silent wraparound (or a hidden int64 "
+                            f"requirement) on the device",
+                        )
+                        iv = Interval(max(iv.lo, lo), min(iv.hi, hi))
+            env[var] = iv
+
+    return [read(v) for v in jaxpr.outvars]
+
+
+# -- dtype / structure scans ---------------------------------------------------
+
+
+def _dtype_findings(closed, spec, ctx) -> None:
+    for j in _iter_jaxprs(closed.jaxpr):
+        for eqn in j.eqns:
+            if eqn.primitive.name in HOST_SYNC_PRIMS:
+                ctx.finding(
+                    "jaxpr-structure",
+                    eqn,
+                    f"host-sync primitive '{eqn.primitive.name}' inside "
+                    f"traced kernel code: a device stall / host round-trip "
+                    f"on the BLS hot path",
+                )
+            for var in eqn.outvars:
+                dt = np.dtype(var.aval.dtype)
+                if dt.name in WIDE_DTYPE_NAMES:
+                    ctx.finding(
+                        "jaxpr-dtype",
+                        eqn,
+                        f"{dt.name} aval produced by '{eqn.primitive.name}': "
+                        f"the limb kernels assume 32-bit lanes (TPU has no "
+                        f"fast 64-bit path; see jax_backend/__init__ x64 "
+                        f"guard)",
+                    )
+                elif dt.kind == "f" and spec.integer_only:
+                    ctx.finding(
+                        "jaxpr-dtype",
+                        eqn,
+                        f"float dtype {dt.name} produced by "
+                        f"'{eqn.primitive.name}' inside an integer-only "
+                        f"kernel: a silent promotion out of the exact limb "
+                        f"domain",
+                    )
+
+
+_MAX_PERIOD = 128  # longest repeated-chunk period searched (eqns)
+_MIN_REPEATS = 20  # instances of the chunk before it counts as an unroll
+_MIN_RUN = 96  # and the run must span at least this many eqns
+
+
+def _structure_findings(closed, ctx) -> None:
+    """Detect long runs of period-p repeated primitive sequences at any
+    jaxpr level: an unrolled Python loop that should be a lax.scan.  The
+    intentional small unrolls in this codebase (pow windows' 14-entry
+    tables, Kogge–Stone levels, Karatsuba folds) sit well under
+    _MIN_REPEATS; unrolls with periods beyond _MAX_PERIOD surface as
+    jaxpr-budget growth instead."""
+    code_of: dict[str, int] = {}
+    for j in _iter_jaxprs(closed.jaxpr):
+        eqns = j.eqns
+        n = len(eqns)
+        if n < _MIN_RUN:
+            continue
+        codes = np.fromiter(
+            (code_of.setdefault(e.primitive.name, len(code_of)) for e in eqns),
+            dtype=np.int32,
+            count=n,
+        )
+        best = None  # (repeats, period, start)
+        for p in range(1, min(_MAX_PERIOD, n // 2) + 1):
+            match = codes[p:] == codes[:-p]
+            if not match.any():
+                continue
+            # longest run of consecutive True
+            padded = np.concatenate(([False], match, [False]))
+            edges = np.flatnonzero(padded[1:] != padded[:-1])
+            starts, ends = edges[0::2], edges[1::2]
+            lengths = ends - starts
+            k = int(lengths.argmax())
+            run = int(lengths[k])
+            if run + p < max(_MIN_RUN, _MIN_REPEATS * p):
+                continue
+            repeats = (run + p) // p
+            if best is None or repeats * p > best[0] * best[1]:
+                best = (repeats, p, int(starts[k]))
+        if best is not None:
+            repeats, p, start = best
+            ctx.finding(
+                "jaxpr-structure",
+                eqns[start],
+                f"unrolled loop: ~{repeats} repeats of a {p}-eqn chunk "
+                f"({repeats * p} inlined eqns) — roll it into lax.scan "
+                f"(XLA compile time tracks inlined op count)",
+            )
+
+
+# -- budgets -------------------------------------------------------------------
+
+
+def load_budgets(path=BUDGETS_PATH) -> dict:
+    p = Path(path)
+    if not p.exists():
+        return {}
+    return json.loads(p.read_text()).get("kernels", {})
+
+
+def save_budgets(counts: dict, path=BUDGETS_PATH) -> None:
+    payload = {
+        "_comment": (
+            "Per-kernel flattened jaxpr primitive counts (trace-only "
+            "baseline). Regenerate with `python scripts/lint.py "
+            "--update-budgets`; the diff of this file is the explanation "
+            "for any compile-cost change a PR makes."
+        ),
+        "kernels": {k: counts[k] for k in sorted(counts)},
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def budget_findings(counts: dict, budgets: dict, registered_names) -> list[Finding]:
+    """Zero-tolerance growth gate: any kernel whose flattened eqn count
+    exceeds its committed baseline fails (shrinkage is silently fine —
+    refresh the baseline to bank it). Missing/stale baseline entries fail
+    too, so the file tracks the registry exactly."""
+    out: list[Finding] = []
+    path = BUDGETS_PATH.relative_to(REPO_ROOT).as_posix()
+    for name, got in sorted(counts.items()):
+        base = budgets.get(name)
+        if base is None:
+            out.append(
+                Finding(
+                    rule="jaxpr-budget",
+                    path=path,
+                    line=0,
+                    symbol=name,
+                    message=(
+                        f"kernel has no committed budget baseline "
+                        f"(traced {got['eqns']} eqns) — run "
+                        f"`python scripts/lint.py --update-budgets`"
+                    ),
+                )
+            )
+            continue
+        if got["eqns"] > base["eqns"]:
+            grew = {
+                prim: got["by_prim"].get(prim, 0) - base.get("by_prim", {}).get(prim, 0)
+                for prim in set(got["by_prim"]) | set(base.get("by_prim", {}))
+            }
+            top = sorted(
+                ((d, prim) for prim, d in grew.items() if d > 0), reverse=True
+            )[:4]
+            detail = ", ".join(f"{prim} +{d}" for d, prim in top) or "totals only"
+            out.append(
+                Finding(
+                    rule="jaxpr-budget",
+                    path=path,
+                    line=0,
+                    symbol=name,
+                    message=(
+                        f"primitive count grew {base['eqns']} -> "
+                        f"{got['eqns']} eqns ({detail}): unexplained "
+                        f"compile-cost growth — optimize, lax.scan the "
+                        f"unroll, or refresh deliberately with "
+                        f"--update-budgets"
+                    ),
+                )
+            )
+    known = set(registered_names)
+    for name in sorted(budgets):
+        if name not in known:
+            out.append(
+                Finding(
+                    rule="jaxpr-budget",
+                    path=path,
+                    line=0,
+                    symbol=name,
+                    message=(
+                        "stale budget baseline: kernel is no longer "
+                        "registered — refresh with --update-budgets"
+                    ),
+                )
+            )
+    return out
+
+
+# -- entry points --------------------------------------------------------------
+
+
+def trace_kernel(spec):
+    """Trace one registered kernel to (ClosedJaxpr, input_ranges). Trace
+    only — nothing compiles, nothing executes on a device."""
+    import jax
+
+    fn, args, ranges = spec.build()
+    leaves = jax.tree_util.tree_leaves(args)
+    if len(ranges) != len(leaves):
+        raise ValueError(
+            f"kernel {spec.name!r}: {len(ranges)} input ranges for "
+            f"{len(leaves)} argument leaves"
+        )
+    closed = jax.make_jaxpr(fn)(*args)
+    if len(closed.jaxpr.invars) != len(leaves):
+        raise ValueError(
+            f"kernel {spec.name!r}: traced invars ({len(closed.jaxpr.invars)}) "
+            f"!= argument leaves ({len(leaves)})"
+        )
+    return closed, [Interval(int(lo), int(hi)) for lo, hi in ranges]
+
+
+def analyze_closed(closed, seeds, spec) -> list[Finding]:
+    """All per-kernel analyses (interval, dtype, structure) over an
+    already-traced jaxpr."""
+    ctx = _Ctx(spec)
+    _dtype_findings(closed, spec, ctx)
+    _structure_findings(closed, ctx)
+    _interp(closed.jaxpr, list(closed.consts), seeds, ctx)
+    return ctx.findings
+
+
+def analyze_kernels(
+    tiers=("fast",), kernels=None, budgets=None
+) -> tuple[list[Finding], dict]:
+    """Trace + analyze registered kernels; returns (findings, counts).
+
+    tiers: registry tiers to include ("fast" is the tier-1 gate; add
+    "slow" for the full composite kernels). kernels: optional explicit
+    name filter. budgets: baseline dict (load_budgets()) to gate against,
+    or None to skip the budget comparison (e.g. while refreshing)."""
+    from ..crypto.bls.jax_backend import registry
+
+    specs = registry.kernel_specs(tiers=tiers)
+    if kernels is not None:
+        wanted = set(kernels)
+        specs = [s for s in specs if s.name in wanted]
+    findings: list[Finding] = []
+    counts: dict = {}
+    for spec in specs:
+        closed, seeds = trace_kernel(spec)
+        counts[spec.name] = count_primitives(closed)
+        findings.extend(analyze_closed(closed, seeds, spec))
+    if budgets is not None:
+        findings.extend(budget_findings(counts, budgets, registry.kernel_names()))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+    return findings, counts

@@ -27,6 +27,11 @@ except that reaped healthy peers):
                   global/nonlocal rebinding, no `self.*` mutation. Any of
                   those inside a jitted trace is a silent host-sync stall
                   (or a value frozen at trace time) on the BLS hot path.
+                  Also: no 64-bit dtypes (`np.int64`/`jnp.int64`/
+                  `astype('int64')`/`dtype='uint64'` …) — the limb kernels
+                  assume 32-bit lanes; WIDE_DTYPE_NAMES below is the single
+                  source of truth shared with the jaxpr-level aval check
+                  (analysis/jaxpr_lint.py), so the two cannot drift.
   metric-name     every literal registered on the metrics registry
                   (`REGISTRY.counter/gauge/histogram[_vec]`) must be
                   `lighthouse_tpu_`-prefixed snake_case, and histogram
@@ -420,6 +425,16 @@ def _assignment_name_for(tree: ast.Module, call: ast.Call) -> str | None:
 TRACE_ENTRY_CALLS = {"jit", "vmap", "pmap", "shard_map", "grad", "value_and_grad"}
 IMPURE_MODULE_CALLS = {"time", "random", "secrets"}
 
+#: 64-bit dtypes forbidden in traced kernel code — the single source of
+#: truth shared with the jaxpr-level aval check (analysis/jaxpr_lint.py
+#: imports this), so the AST lint and the jaxpr dtype lint cannot drift.
+#: The limb kernels assume 32-bit lanes (fp.py: no int64 anywhere on the
+#: hot path; jax_backend/__init__ guards jax_enable_x64 at import).
+WIDE_DTYPE_NAMES = frozenset({"int64", "uint64", "float64"})
+
+#: module roots whose 64-bit dtype attributes we flag inside traced code
+_DTYPE_MODULE_ROOTS = {"np", "numpy", "jnp", "jax"}
+
 
 class TracePurityChecker(Checker):
     name = "trace-purity"
@@ -512,9 +527,43 @@ class TracePurityChecker(Checker):
                 )
             )
 
+        def flag_wide_dtype(node, how: str) -> None:
+            findings.append(
+                Finding(
+                    rule=self.name,
+                    path=path,
+                    line=node.lineno,
+                    symbol=qual,
+                    message=(
+                        f"{how} inside a traced function: the limb kernels "
+                        f"assume 32-bit lanes (no fast 64-bit path on the "
+                        f"accelerator; the jaxpr analyzer rejects the same "
+                        f"dtypes on traced avals — WIDE_DTYPE_NAMES)"
+                    ),
+                )
+            )
+
         for node in ast.walk(fn):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr in WIDE_DTYPE_NAMES
+                and _attr_chain(node)[:1]
+                and _attr_chain(node)[0] in _DTYPE_MODULE_ROOTS
+            ):
+                flag_wide_dtype(node, f"64-bit dtype {'.'.join(_attr_chain(node))}")
             if isinstance(node, ast.Call):
                 chain = _attr_chain(node.func)
+                # astype("int64") / astype(dtype=...) / zeros(dtype="int64"):
+                # string dtype forms the Attribute check above cannot see
+                dtype_args = list(node.args) if chain[-1:] == ["astype"] else []
+                dtype_args += [kw.value for kw in node.keywords if kw.arg == "dtype"]
+                for arg in dtype_args:
+                    if (
+                        isinstance(arg, ast.Constant)
+                        and isinstance(arg.value, str)
+                        and arg.value.lstrip("<>=") in WIDE_DTYPE_NAMES
+                    ):
+                        flag_wide_dtype(node, f"64-bit dtype {arg.value!r}")
                 if len(chain) >= 2 and chain[0] in IMPURE_MODULE_CALLS:
                     flag(node, f"call to {'.'.join(chain)}")
                 elif len(chain) >= 3 and chain[0] in {"np", "numpy"} and chain[1] == "random":

@@ -1,0 +1,29 @@
+"""jax BLS backend package: import-time environment guards.
+
+The 12-bit-limb int32 kernels (fp.py) are proven overflow-safe by the
+jaxpr interval analyzer (analysis/jaxpr_lint.py) under jax's DEFAULT
+32-bit world.  With `jax_enable_x64` on, weakly-typed literals and
+np->jnp conversions silently widen to int64, changing every width
+assumption the proofs rest on (and hitting XLA's slow emulated 64-bit
+path on TPU) — so an x64 interpreter is refused loudly at import instead
+of producing subtly different kernels.
+"""
+
+import jax
+
+
+def assert_x64_disabled() -> None:
+    """Fail fast if jax_enable_x64 is on (also re-checkable at runtime —
+    tests call this under jax.experimental.enable_x64)."""
+    if jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "lighthouse_tpu's jax backend requires jax_enable_x64=False: "
+            "the int32 limb kernels silently change width assumptions "
+            "under x64, invalidating the analyzer's overflow proofs "
+            "(analysis/jaxpr_lint.py). Unset JAX_ENABLE_X64 / call "
+            "jax.config.update('jax_enable_x64', False) before importing "
+            "the backend."
+        )
+
+
+assert_x64_disabled()

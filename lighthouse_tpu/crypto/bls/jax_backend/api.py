@@ -582,3 +582,47 @@ def interop_secret_key(validator_index: int) -> SecretKey:
 def interop_keypair(validator_index: int) -> tuple[SecretKey, PublicKey]:
     sk = interop_secret_key(validator_index)
     return sk, sk.public_key()
+
+
+# -- analyzer registry hooks ---------------------------------------------------
+#
+# The full per-shard pipeline at representative (S, K) bucket shapes: the
+# top of the funnel every registered stage kernel feeds. ~150 s to TRACE
+# each on this box, so slow-tier only (`scripts/lint.py --jaxpr
+# --all-tiers` / the nightly @slow gate; the fast tier already covers
+# every stage individually). The seeds mirror stage_sets' staging
+# contract: canonical Montgomery limbs, 0/1 infinity masks, 0/1 scalar-bit
+# rows.
+
+from . import registry as _reg
+
+
+def _verify_pipeline_spec(S: int, K: int):
+    from .fp import N_LIMBS
+
+    args = (
+        np.zeros((S, K, N_LIMBS), np.int32),  # pk_x
+        np.zeros((S, K, N_LIMBS), np.int32),  # pk_y
+        np.zeros((S, K), bool),  # pk_inf
+        np.zeros((S, 2, N_LIMBS), np.int32),  # sig_x
+        np.zeros((S, 2, N_LIMBS), np.int32),  # sig_y
+        np.zeros(S, bool),  # sig_inf
+        np.zeros((S, 2, 2, N_LIMBS), np.int32),  # u
+        np.zeros((S, 64), np.int32),  # r_bits
+    )
+    ranges = [
+        _reg.LIMB, _reg.LIMB, _reg.BOOL,
+        _reg.LIMB, _reg.LIMB, _reg.BOOL,
+        _reg.LIMB, _reg.BIT,
+    ]
+    return verify_pipeline_local, args, ranges
+
+
+@_reg.register("api.verify_pipeline_local@S4K4", tier="slow")
+def _spec_verify_s4k4():
+    return _verify_pipeline_spec(4, 4)
+
+
+@_reg.register("api.verify_pipeline_local@S8K2", tier="slow")
+def _spec_verify_s8k2():
+    return _verify_pipeline_spec(8, 2)

@@ -288,3 +288,108 @@ def g2_in_subgroup_full(p: Proj):
 
 # Backwards-compatible alias: earlier code calls the point container "Jac".
 Jac = Proj
+
+
+# -- analyzer registry hooks ---------------------------------------------------
+#
+# The group law and the ladders are exactly what ROADMAP item 1 rewrites
+# (windowed/NAF tables, batch-affine conversion): registering them here
+# means the rewrite lands against the jaxpr analyzer's interval proofs and
+# primitive-count budgets, per field (G1/Fp and G2/Fp2 instantiate the
+# generic code differently).
+
+from . import registry as _reg
+
+_SM_BATCH = 4  # representative batch for ladder specs (shape-independent
+#                eqn structure; S only changes broadcast dims)
+
+
+def _g1_affine(batch=()):
+    x = np.zeros((*batch, fp.N_LIMBS), np.int32)
+    return x, x.copy(), np.zeros(batch, bool)
+
+
+def _g2_affine(batch=()):
+    x = np.zeros((*batch, 2, fp.N_LIMBS), np.int32)
+    return x, x.copy(), np.zeros(batch, bool)
+
+
+def _proj_spec(F, coords_of):
+    """(fn, args, ranges) for add on a pair of affine-derived points."""
+    x, y, inf = coords_of()
+    qx, qy, qinf = coords_of()
+
+    def fn(x, y, inf, qx, qy, qinf):
+        return add(F, from_affine(F, x, y, inf), from_affine(F, qx, qy, qinf))
+
+    ranges = [_reg.LIMB, _reg.LIMB, _reg.BOOL] * 2
+    return fn, (x, y, inf, qx, qy, qinf), ranges
+
+
+@_reg.register("curve.add.g1")
+def _spec_add_g1():
+    return _proj_spec(FP, _g1_affine)
+
+
+@_reg.register("curve.add.g2")
+def _spec_add_g2():
+    return _proj_spec(FP2, _g2_affine)
+
+
+def _scalar_mul_spec(F, coords_of):
+    x, y, inf = coords_of((_SM_BATCH,))
+    bits = np.zeros((_SM_BATCH, 64), np.int32)
+
+    def fn(x, y, inf, bits):
+        return scalar_mul_bits(F, from_affine(F, x, y, inf), bits)
+
+    return fn, (x, y, inf, bits), [_reg.LIMB, _reg.LIMB, _reg.BOOL, _reg.BIT]
+
+
+@_reg.register("curve.scalar_mul_bits.g1")
+def _spec_smul_g1():
+    return _scalar_mul_spec(FP, _g1_affine)
+
+
+@_reg.register("curve.scalar_mul_bits.g2")
+def _spec_smul_g2():
+    return _scalar_mul_spec(FP2, _g2_affine)
+
+
+def _to_affine_spec(F, coords_of):
+    x, y, inf = coords_of((_SM_BATCH,))
+
+    def fn(x, y, inf):
+        return to_affine(F, from_affine(F, x, y, inf))
+
+    return fn, (x, y, inf), [_reg.LIMB, _reg.LIMB, _reg.BOOL]
+
+
+@_reg.register("curve.to_affine.g1")
+def _spec_to_affine_g1():
+    return _to_affine_spec(FP, _g1_affine)
+
+
+@_reg.register("curve.to_affine.g2", tier="slow")
+def _spec_to_affine_g2():
+    return _to_affine_spec(FP2, _g2_affine)
+
+
+@_reg.register("curve.g1_in_subgroup", tier="slow")
+def _spec_g1_subgroup():
+    x, y, inf = _g1_affine((_SM_BATCH,))
+
+    def fn(x, y, inf):
+        return g1_in_subgroup(from_affine(FP, x, y, inf))
+
+    return fn, (x, y, inf), [_reg.LIMB, _reg.LIMB, _reg.BOOL]
+
+
+@_reg.register("curve.g2_in_subgroup")
+def _spec_g2_subgroup():
+    x, y, inf = _g2_affine((_SM_BATCH,))
+
+    def fn(x, y, inf):
+        return g2_in_subgroup(from_affine(FP2, x, y, inf))
+
+    return fn, (x, y, inf), [_reg.LIMB, _reg.LIMB, _reg.BOOL]

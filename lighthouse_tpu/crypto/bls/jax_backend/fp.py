@@ -399,3 +399,52 @@ def sgn0_mont(a: jnp.ndarray) -> jnp.ndarray:
     Montgomery form, so convert down first — this is off the hot path (used
     once per SSWU evaluation)."""
     return from_mont(a)[..., 0] & 1
+
+
+# -- analyzer registry hooks ---------------------------------------------------
+#
+# Trace-only kernel specs for the jaxpr analyzer (analysis/jaxpr_lint.py).
+# Seeds encode the representation invariants documented above: canonical
+# limbs in [0, 2^12) (LIMB) and poly()-contract columns (COLS). The
+# analyzer re-proves the module docstring's int32 claim from these on
+# every lint/test run.
+
+from . import registry as _reg
+
+
+def _limb_vec():
+    return np.zeros(N_LIMBS, np.int32)
+
+
+@_reg.register("fp.add")
+def _spec_add():
+    a = _limb_vec()
+    return add, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("fp.sub")
+def _spec_sub():
+    a = _limb_vec()
+    return sub, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("fp.neg")
+def _spec_neg():
+    return neg, (_limb_vec(),), [_reg.LIMB]
+
+
+@_reg.register("fp.mul")
+def _spec_mul():
+    a = _limb_vec()
+    return mul, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("fp.mont_reduce")
+def _spec_redc():
+    cols = np.zeros(2 * N_LIMBS - 1, np.int32)
+    return (lambda c: redc(c, mult=2)), (cols,), [_reg.COLS]
+
+
+@_reg.register("fp.inv")
+def _spec_inv():
+    return inv, (_limb_vec(),), [_reg.LIMB]

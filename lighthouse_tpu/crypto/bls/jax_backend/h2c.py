@@ -311,3 +311,44 @@ def hash_to_field_limbs(messages: list[bytes], dst: bytes = DST) -> np.ndarray:
 def hash_to_g2_device(u: jnp.ndarray) -> Jac:
     """u: (..., 2, 2, 32) packed field elements -> G2 Jacobian points."""
     return map_to_g2(u[..., 0, :, :], u[..., 1, :, :])
+
+
+# -- analyzer registry hooks ---------------------------------------------------
+#
+# The SSWU/isogeny/cofactor stages register individually in the fast tier
+# (each traces in seconds); the fused hash_to_g2_device composite takes
+# ~60 s to trace, so it is slow-tier (`scripts/lint.py --jaxpr
+# --all-tiers` / the nightly @slow gate).
+
+from . import registry as _reg
+
+
+def _u2(batch=()):
+    return np.zeros((*batch, 2, fp.N_LIMBS), np.int32)
+
+
+@_reg.register("h2c.fp2_sqrt_candidate")
+def _spec_sqrt():
+    return fp2_sqrt_candidate, (_u2(),), [_reg.LIMB]
+
+
+@_reg.register("h2c.iso3_map")
+def _spec_iso3():
+    a = _u2()
+    return iso3_map, (a, a.copy()), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("h2c.clear_cofactor", tier="slow")
+def _spec_clear_cofactor():
+    x = _u2((4,))
+
+    def fn(x, y, z):
+        return clear_cofactor(Jac(x, y, z))
+
+    return fn, (x, x.copy(), x.copy()), [_reg.LIMB] * 3
+
+
+@_reg.register("h2c.hash_to_g2_device", tier="slow")
+def _spec_hash_to_g2():
+    u = np.zeros((4, 2, 2, fp.N_LIMBS), np.int32)
+    return hash_to_g2_device, (u,), [_reg.LIMB]

@@ -290,3 +290,49 @@ def multi_pairing(px, py, p_inf, qx, qy, q_inf):
     verify_multiple_aggregate_signatures shape (impls/blst.rs:114-116)."""
     fs = miller_loop(px, py, p_inf, qx, qy, q_inf)
     return final_exponentiation(product_reduce(fs))
+
+
+# -- analyzer registry hooks ---------------------------------------------------
+#
+# _pow_abs_x and frobenius are fast-tier (the Karabina compressed-squaring
+# rewrite of ROADMAP item 1 lands in _pow_abs_x); the Miller loop and the
+# full final exponentiation are slow-tier — they take ~13 s / ~17 s just to
+# TRACE on this box, so they run under `scripts/lint.py --jaxpr --all-tiers`
+# and the nightly @slow gate rather than tier-1.
+
+from . import registry as _reg
+
+
+def _f12_batch(batch=()):
+    return np.zeros((*batch, 2, 3, 2, fp.N_LIMBS), np.int32)
+
+
+@_reg.register("pairing.pow_abs_x")
+def _spec_pow_abs_x():
+    return _pow_abs_x, (_f12_batch(),), [_reg.LIMB]
+
+
+@_reg.register("pairing.frobenius")
+def _spec_frobenius():
+    return frobenius, (_f12_batch(),), [_reg.LIMB]
+
+
+@_reg.register("pairing.product_reduce")
+def _spec_product_reduce():
+    return product_reduce, (_f12_batch((5,)),), [_reg.LIMB]
+
+
+@_reg.register("pairing.miller_loop", tier="slow")
+def _spec_miller():
+    S = 5  # S sets + the (-g1, sig_acc) pair, as verify_pipeline stages it
+    px = np.zeros((S, fp.N_LIMBS), np.int32)
+    qx = np.zeros((S, 2, fp.N_LIMBS), np.int32)
+    inf = np.zeros(S, bool)
+    args = (px, px.copy(), inf, qx, qx.copy(), inf.copy())
+    ranges = [_reg.LIMB, _reg.LIMB, _reg.BOOL, _reg.LIMB, _reg.LIMB, _reg.BOOL]
+    return miller_loop, args, ranges
+
+
+@_reg.register("pairing.final_exponentiation", tier="slow")
+def _spec_final_exp():
+    return final_exponentiation, (_f12_batch(),), [_reg.LIMB]

@@ -1,0 +1,113 @@
+"""Registry of device kernels for the jaxpr analyzer (analysis/jaxpr_lint).
+
+Every jit-reachable BLS kernel registers itself here (a `@register` hook at
+the bottom of its defining module) with a builder that returns
+
+    (fn, example_args, input_ranges)
+
+where `fn(*example_args)` is traceable by `jax.make_jaxpr` (trace-only —
+builders must never compile or execute device code) and `input_ranges` is a
+flat list of `(lo, hi)` integer pairs, one per `jax.tree_util.tree_leaves(
+example_args)` leaf, seeding the interval analysis with each input's
+precondition.  The canonical seeds:
+
+    LIMB  [0, 2^12)      canonical Montgomery limbs (fp.py representation
+                         invariant — the precondition every proof starts from)
+    COLS  [0, 32*2^24]   unreduced schoolbook columns (fp.py poly() contract:
+                         inputs in [0, 4096], 32 products per column)
+    BIT   [0, 1]         scalar bit tables / traced bit arrays
+    BOOL  [0, 1]         infinity masks and other predicates
+
+Tiers bound the cost of the gate on the 1-core CPU box (tracing is pure
+Python and scales with inlined eqn count):
+
+    fast   traces in ~seconds; the tier-1 test gate.  Covers the whole
+           field/tower/curve/pow surface — i.e. everything ROADMAP item 1
+           (windowed mul, Karabina squaring, batch-affine) rewrites.
+    slow   the big composites (Miller loop ~13 s, final exp ~17 s, full
+           hash-to-G2 ~60 s, verify_pipeline_local ~150 s to trace).  Run
+           by `scripts/lint.py --jaxpr --all-tiers` and the @slow test.
+
+Budgets (scripts/jaxpr_budgets.json) cover BOTH tiers; refresh with
+`python scripts/lint.py --update-budgets`.
+
+New kernels (including sharded ones — ROADMAP item 2 registers shard_map
+bodies the same way) get analyzed by adding one `@register` hook; the
+analyzer and the budget baseline pick them up by name.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+#: canonical interval seeds (see module docstring)
+LIMB = (0, (1 << 12) - 1)
+COLS = (0, 32 * (1 << 12) * (1 << 12))
+BIT = (0, 1)
+BOOL = (0, 1)
+
+TIERS = ("fast", "slow")
+
+
+@dataclass(frozen=True)
+class KernelSpec:
+    name: str  # stable registry key, e.g. "fp.mul", "api.verify_pipeline@S4K4"
+    tier: str  # "fast" | "slow"
+    build: Callable  # () -> (fn, example_args, input_ranges)
+    integer_only: bool = True  # float avals in the trace are findings
+    module: str = ""  # defining module (Finding fallback provenance)
+
+
+_KERNELS: dict[str, KernelSpec] = {}
+_collected = False
+
+
+def register(name: str, *, tier: str = "fast", integer_only: bool = True):
+    """Decorator for kernel-spec builders. The builder runs lazily (only
+    when the analyzer traces), so registration at import time is free."""
+    if tier not in TIERS:
+        raise ValueError(f"unknown tier {tier!r} (want one of {TIERS})")
+
+    def deco(build: Callable) -> Callable:
+        if name in _KERNELS:
+            raise ValueError(f"duplicate kernel registration {name!r}")
+        _KERNELS[name] = KernelSpec(
+            name=name,
+            tier=tier,
+            build=build,
+            integer_only=integer_only,
+            module=build.__module__,
+        )
+        return build
+
+    return deco
+
+
+def _collect() -> None:
+    """Import every kernel-defining module so its hooks have registered."""
+    global _collected
+    if _collected:
+        return
+    from . import api, curve, fp, h2c, pairing, tower  # noqa: F401
+
+    _collected = True
+
+
+def kernel_specs(tiers=None) -> list[KernelSpec]:
+    """All registered kernels (optionally filtered by tier), name-sorted."""
+    _collect()
+    out = [
+        s
+        for s in _KERNELS.values()
+        if tiers is None or s.tier in tiers
+    ]
+    return sorted(out, key=lambda s: s.name)
+
+
+def kernel_names() -> list[str]:
+    """Names of ALL registered kernels regardless of tier (budget staleness
+    is judged against this, so a fast-tier-only run never mistakes a
+    slow-tier baseline entry for stale)."""
+    _collect()
+    return sorted(_KERNELS)

@@ -422,3 +422,71 @@ def fp12_is_one(a):
 
 def fp12_select(cond, a, b):
     return jnp.where(cond[..., None, None, None, None], a, b)
+
+
+# -- analyzer registry hooks ---------------------------------------------------
+#
+# The tower muls carry the tightest lazy-reduction bounds in the codebase
+# (see the contract comments at _flat_mul / fp2_sqr): the jaxpr analyzer
+# re-derives them from the canonical-limb seed on every run, so a rewrite
+# (Karabina compressed squaring lands here) cannot silently break them.
+
+from . import registry as _reg
+
+
+def _f2():
+    return np.zeros((2, fp.N_LIMBS), np.int32)
+
+
+def _f6():
+    return np.zeros((3, 2, fp.N_LIMBS), np.int32)
+
+
+def _f12():
+    return np.zeros((2, 3, 2, fp.N_LIMBS), np.int32)
+
+
+@_reg.register("tower.fp2_mul")
+def _spec_fp2_mul():
+    a = _f2()
+    return fp2_mul, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("tower.fp2_sqr")
+def _spec_fp2_sqr():
+    return fp2_sqr, (_f2(),), [_reg.LIMB]
+
+
+@_reg.register("tower.fp2_inv", tier="slow")
+def _spec_fp2_inv():
+    return fp2_inv, (_f2(),), [_reg.LIMB]
+
+
+@_reg.register("tower.fp6_mul")
+def _spec_fp6_mul():
+    a = _f6()
+    return fp6_mul, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("tower.fp12_mul")
+def _spec_fp12_mul():
+    a = _f12()
+    return fp12_mul, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("tower.fp12_sqr")
+def _spec_fp12_sqr():
+    return fp12_sqr, (_f12(),), [_reg.LIMB]
+
+
+@_reg.register("tower.fp12_mul_sparse035")
+def _spec_fp12_mul_sparse():
+    def fn(a, b0, b3, b5):
+        return fp12_mul_sparse035(a, b0, b3, b5)
+
+    return fn, (_f12(), _f2(), _f2(), _f2()), [_reg.LIMB] * 4
+
+
+@_reg.register("tower.fp12_inv", tier="slow")
+def _spec_fp12_inv():
+    return fp12_inv, (_f12(),), [_reg.LIMB]

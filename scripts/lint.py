@@ -1,18 +1,35 @@
 #!/usr/bin/env python
 """Run the repo-native analyzers (lighthouse_tpu/analysis) over the tree.
 
-    python scripts/lint.py            # human-readable report
+    python scripts/lint.py            # human-readable report (AST lints)
     python scripts/lint.py --check    # CI gate: exit 1 on any unallowlisted
                                       # finding or stale allowlist entry
     python scripts/lint.py --json     # machine-readable findings
     python scripts/lint.py network/   # lint a subset (paths relative to repo)
 
+    python scripts/lint.py --jaxpr            # ALSO run the jaxpr kernel
+                                              # analyses (fast tier: interval
+                                              # overflow proofs, dtype/
+                                              # structure lints, budgets)
+    python scripts/lint.py --jaxpr --all-tiers  # include the slow composites
+                                              # (miller/final-exp/h2c/verify
+                                              # pipeline; several minutes of
+                                              # trace time)
+    python scripts/lint.py --update-budgets   # refresh the committed op-count
+                                              # baseline (all tiers; the diff
+                                              # of scripts/jaxpr_budgets.json
+                                              # is the explanation reviewers
+                                              # see)
+
 Allowlist: scripts/lint_allowlist.txt — one `rule:path:symbol` per line,
 each with a mandatory `  # one-line justification`. Unjustified or stale
 entries fail the run: suppressions are reviewed code, not a dumping ground.
 
-Deliberately free of jax imports: the analyzers read source, they never
-execute it, so this runs in a few seconds anywhere (no device, no cache).
+The default (AST-only) path is deliberately free of jax imports — the
+analyzers read source, they never execute it, so `--check` runs in a few
+seconds anywhere. `--jaxpr` imports jax and TRACES the registered BLS
+kernels (crypto/bls/jax_backend/registry.py) to closed jaxprs — still
+trace-only (no compilation, no device), ~1 min for the fast tier on CPU.
 """
 
 from __future__ import annotations
@@ -37,11 +54,53 @@ DEFAULT_PATHS = ["lighthouse_tpu"]
 ALLOWLIST = REPO_ROOT / "scripts" / "lint_allowlist.txt"
 
 
+def _jaxpr_findings(all_tiers: bool, update_budgets: bool):
+    """Deferred import: jax only loads under --jaxpr/--update-budgets."""
+    import os
+
+    # trace-only gate: pin the (not-yet-initialized) backend to CPU so an
+    # ambient accelerator env doesn't pull trace constants over the device
+    # tunnel (~10 ms per transfer on the tunnelled link)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from lighthouse_tpu.analysis import jaxpr_lint
+
+    tiers = ("fast", "slow") if (all_tiers or update_budgets) else ("fast",)
+    budgets = None if update_budgets else jaxpr_lint.load_budgets()
+    findings, counts = jaxpr_lint.analyze_kernels(tiers=tiers, budgets=budgets)
+    if update_budgets:
+        jaxpr_lint.save_budgets(counts)
+        print(
+            f"wrote {jaxpr_lint.BUDGETS_PATH.relative_to(REPO_ROOT)} "
+            f"({len(counts)} kernels)",
+            file=sys.stderr,
+        )
+    return findings
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*", default=None, help="files/dirs (default: lighthouse_tpu)")
     ap.add_argument("--check", action="store_true", help="exit 1 on unallowlisted findings")
     ap.add_argument("--json", action="store_true", dest="as_json", help="JSON output")
+    ap.add_argument(
+        "--jaxpr",
+        action="store_true",
+        help="also trace+analyze the registered BLS kernels (interval "
+        "overflow proofs, dtype/structure lints, op-count budgets)",
+    )
+    ap.add_argument(
+        "--all-tiers",
+        action="store_true",
+        help="with --jaxpr: include the slow-tier composites (several "
+        "minutes of trace time)",
+    )
+    ap.add_argument(
+        "--update-budgets",
+        action="store_true",
+        help="refresh scripts/jaxpr_budgets.json from the current tree "
+        "(implies --jaxpr --all-tiers; skips the budget comparison)",
+    )
     ap.add_argument(
         "--allowlist", default=str(ALLOWLIST), help="allowlist file (default: %(default)s)"
     )
@@ -51,6 +110,9 @@ def main(argv=None) -> int:
     try:
         entries = load_allowlist(args.allowlist)
         findings = run_lints(paths, default_checkers(), root=REPO_ROOT)
+        if args.jaxpr or args.update_budgets:
+            findings = findings + _jaxpr_findings(args.all_tiers, args.update_budgets)
+            findings.sort(key=lambda f: (f.path, f.line, f.rule))
         kept, suppressed, stale = apply_allowlist(findings, entries)
     except LintConfigError as e:
         print(f"lint configuration error: {e}", file=sys.stderr)

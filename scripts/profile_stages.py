@@ -25,6 +25,14 @@ Run: python scripts/profile_stages.py   (on the bench platform)
          counters a /metrics scrape would show. Host-only — no device
          kernels run. Env: PROFILE_STAGING_SETS (64),
          PROFILE_STAGING_MSGS (8), PROFILE_REPS (5).
+     python scripts/profile_stages.py --opcounts
+         per-kernel jaxpr primitive counts from the analyzer registry
+         (trace-only, no device) next to the committed budget baseline —
+         op-count deltas read side by side with the wall-time deltas the
+         other modes print. Standalone: fast tier only by default
+         (PROFILE_OPCOUNTS_TIER=all adds the slow composites). Combined
+         with the default device profile, the table prints after the span
+         breakdown so one run shows both.
 """
 
 import os
@@ -210,6 +218,42 @@ def staging_main() -> None:
         )
 
 
+def print_opcounts() -> None:
+    """--opcounts: the analyzer registry's per-kernel primitive counts vs
+    the committed baseline (scripts/jaxpr_budgets.json) — the compile-cost
+    side of the profile (trace-only; pairs with the wall-time numbers)."""
+    from lighthouse_tpu.analysis import jaxpr_lint
+    from lighthouse_tpu.crypto.bls.jax_backend import registry
+
+    tiers = (
+        ("fast", "slow")
+        if os.environ.get("PROFILE_OPCOUNTS_TIER") == "all"
+        else ("fast",)
+    )
+    budgets = jaxpr_lint.load_budgets()
+    print(
+        f"\nper-kernel jaxpr primitive counts (tiers={'+'.join(tiers)}; "
+        f"baseline scripts/jaxpr_budgets.json):",
+        flush=True,
+    )
+    print(f"  {'kernel':34s} {'eqns':>7s} {'budget':>7s} {'delta':>7s}  top primitives")
+    for spec in registry.kernel_specs(tiers=tiers):
+        t0 = time.perf_counter()
+        closed, _seeds = jaxpr_lint.trace_kernel(spec)
+        counts = jaxpr_lint.count_primitives(closed)
+        trace_s = time.perf_counter() - t0
+        base = budgets.get(spec.name, {}).get("eqns")
+        delta = "" if base is None else f"{counts['eqns'] - base:+7d}"
+        budget = "-" if base is None else str(base)
+        top = sorted(counts["by_prim"].items(), key=lambda kv: -kv[1])[:3]
+        top_s = " ".join(f"{k}:{v}" for k, v in top)
+        print(
+            f"  {spec.name:34s} {counts['eqns']:7d} {budget:>7s} {delta:>7s}"
+            f"  {top_s}  (trace {trace_s:.1f}s)",
+            flush=True,
+        )
+
+
 def main() -> None:
     import jax
     # the ambient plugin pins the persistent-cache threshold at startup;
@@ -338,11 +382,20 @@ def main() -> None:
             flush=True,
         )
 
+    # op-count deltas next to the wall-time deltas above (one run, both axes)
+    if "--opcounts" in sys.argv:
+        print_opcounts()
+
 
 if __name__ == "__main__":
     if "--coalesce" in sys.argv:
         coalesce_main()
     elif "--staging" in sys.argv:
         staging_main()
+    elif sys.argv[1:] == ["--opcounts"]:
+        # standalone table is trace-only: pin the (uninitialized) backend to
+        # CPU so trace constants never ride the tunnelled device link
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print_opcounts()
     else:
-        main()
+        main()  # appends the opcounts table when --opcounts is also passed

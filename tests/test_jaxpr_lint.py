@@ -1,0 +1,276 @@
+"""The jaxpr kernel analyzer's own gate: seeded-bad fixtures prove each
+analysis catches its bug class WITH eqn-level source provenance, known-good
+fixtures stay quiet, the budget machinery fails on regressions/staleness,
+the x64 import guard refuses a widened interpreter — and, the tier-1
+teeth, the fast-tier registry kernels are PROVEN int32-overflow-free from
+the canonical-limb precondition against the committed op-count baseline.
+Everything here is trace-only (jax.make_jaxpr): no compilation, no device.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from lighthouse_tpu.analysis import jaxpr_lint
+from lighthouse_tpu.crypto.bls.jax_backend import registry
+from lighthouse_tpu.crypto.bls.jax_backend.registry import KernelSpec
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+THIS_FILE = Path(__file__).resolve().relative_to(REPO_ROOT).as_posix()
+
+LIMB12 = (0, (1 << 12) - 1)
+LIMB13 = (0, (1 << 13) - 1)
+
+
+def analyze_fixture(fn, args, ranges, integer_only=True, name="fixture"):
+    spec = KernelSpec(
+        name=name,
+        tier="fast",
+        build=lambda: (fn, args, ranges),
+        integer_only=integer_only,
+        module=__name__,
+    )
+    closed, seeds = jaxpr_lint.trace_kernel(spec)
+    return jaxpr_lint.analyze_closed(closed, seeds, spec)
+
+
+# -- seeded-bad: 13-bit limb mul overflows int32 -------------------------------
+
+
+def _schoolbook_columns(a, b):
+    """Column sums of a 32x32 limb product plus one Montgomery-style
+    accumulation — the exact shape of fp.mul's redc input."""
+    outer = a[:, None] * b[None, :]  # (32, 32)
+    cols = jnp.sum(outer, axis=0)  # 32 products per column
+    return cols + cols  # + the m*p accumulation redc adds
+
+
+def test_interval_catches_13_bit_limb_overflow():
+    """With 13-bit limbs the column sum + Montgomery accumulation is
+    32*(2^13-1)^2 * 2 ~ 2^32 > int32: the docstring bound fp.py relies on
+    breaks, and the analyzer must say so with source provenance."""
+    a = np.zeros(32, np.int32)
+    findings = analyze_fixture(_schoolbook_columns, (a, a), [LIMB13, LIMB13])
+    overflow = [f for f in findings if f.rule == "jaxpr-interval"]
+    assert overflow, [f.format() for f in findings]
+    f = overflow[0]
+    assert "exceeds int32" in f.message and "proven value range" in f.message
+    # eqn-level provenance: the finding points into THIS file at the line
+    # of the offending accumulation
+    assert f.path == THIS_FILE
+    assert f.line > 0
+    assert f.symbol == "fixture"
+
+
+def test_interval_proves_12_bit_limb_scheme_safe():
+    """The same graph with the real 12-bit precondition fits int32 — the
+    analyzer proves fp.py's comment rather than pattern-matching it."""
+    a = np.zeros(32, np.int32)
+    findings = analyze_fixture(_schoolbook_columns, (a, a), [LIMB12, LIMB12])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_interval_checks_while_loop_condition():
+    """The termination test of a lax.while_loop runs on-device with the
+    same carry values as the body — an overflow there wraps just as hard
+    and must be reported (regression: the cond jaxpr was once skipped)."""
+
+    def kern(x):
+        def cond(c):
+            return jnp.all(c * c * c * 512 < 7)  # [0,4095]^3 * 512 ~ 2^45
+
+        def body(c):
+            return c & 0xFFF
+
+        return lax.while_loop(cond, body, x)
+
+    findings = analyze_fixture(kern, (np.zeros(8, np.int32),), [LIMB12])
+    assert any(
+        f.rule == "jaxpr-interval" and "exceeds int32" in f.message
+        for f in findings
+    ), [f.format() for f in findings]
+
+
+def test_interval_flags_unhandled_primitive_instead_of_passing():
+    findings = analyze_fixture(
+        lambda x: lax.population_count(x), (np.zeros(8, np.int32),), [LIMB12]
+    )
+    assert any(
+        f.rule == "jaxpr-interval" and "unhandled primitive" in f.message
+        for f in findings
+    ), [f.format() for f in findings]
+
+
+# -- seeded-bad: unrolled 64-iteration Python loop -----------------------------
+
+
+def _unrolled_64(x):
+    acc = x
+    for _ in range(64):
+        acc = (acc * 3 + 1) & 0x7FF
+    return acc
+
+
+def _scanned_64(x):
+    def step(acc, _):
+        return (acc * 3 + 1) & 0x7FF, None
+
+    acc, _ = lax.scan(step, x, None, length=64)
+    return acc
+
+
+def test_structure_catches_unrolled_python_loop():
+    x = np.zeros(8, np.int32)
+    findings = analyze_fixture(_unrolled_64, (x,), [(0, 2047)])
+    unrolled = [f for f in findings if f.rule == "jaxpr-structure"]
+    assert unrolled, [f.format() for f in findings]
+    assert "lax.scan" in unrolled[0].message
+    assert unrolled[0].path == THIS_FILE and unrolled[0].line > 0
+
+
+def test_structure_quiet_on_lax_scan_form():
+    x = np.zeros(8, np.int32)
+    findings = analyze_fixture(_scanned_64, (x,), [(0, 2047)])
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_structure_catches_host_sync_primitive():
+    def synced(x):
+        jax.debug.print("x={x}", x=x)
+        return x + 1
+
+    findings = analyze_fixture(synced, (np.zeros(4, np.int32),), [LIMB12])
+    assert any(
+        f.rule == "jaxpr-structure" and "host-sync" in f.message for f in findings
+    ), [f.format() for f in findings]
+
+
+# -- seeded-bad: int64 / float promotions --------------------------------------
+
+
+def test_dtype_catches_int64_promotion_under_x64():
+    """Under an x64 interpreter (what the import guard forbids) an explicit
+    astype(int64) becomes a wide aval; the jaxpr dtype rule reports it with
+    provenance. Under default config the promotion can't even appear — the
+    AST lint (lints.TracePurityChecker) owns the source-level front door."""
+
+    def widen(x):
+        return x.astype(jnp.int64) * 2
+
+    with jax.experimental.enable_x64():
+        findings = analyze_fixture(widen, (np.zeros(8, np.int32),), [LIMB12])
+    wide = [f for f in findings if f.rule == "jaxpr-dtype"]
+    assert wide and "int64" in wide[0].message, [f.format() for f in findings]
+    assert wide[0].path == THIS_FILE
+
+
+def test_dtype_catches_float_promotion_in_integer_kernel():
+    def leak(x):
+        return (x * 1.5).astype(jnp.int32)
+
+    findings = analyze_fixture(leak, (np.zeros(8, np.int32),), [LIMB12])
+    assert any(
+        f.rule == "jaxpr-dtype" and "float" in f.message for f in findings
+    ), [f.format() for f in findings]
+
+
+def test_wide_dtypes_single_sourced_with_ast_lint():
+    from lighthouse_tpu.analysis.lints import WIDE_DTYPE_NAMES as ast_names
+
+    assert jaxpr_lint.WIDE_DTYPE_NAMES is ast_names
+
+
+# -- budgets -------------------------------------------------------------------
+
+
+def _counts(eqns, **by_prim):
+    return {"eqns": eqns, "by_prim": by_prim}
+
+
+def test_budget_regression_fails():
+    counts = {"k": _counts(100, add=60, mul=40)}
+    budgets = {"k": _counts(90, add=50, mul=40)}
+    findings = jaxpr_lint.budget_findings(counts, budgets, ["k"])
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "jaxpr-budget" and f.symbol == "k"
+    assert "90 -> 100" in f.message and "add +10" in f.message
+
+
+def test_budget_equal_and_shrink_pass():
+    budgets = {"k": _counts(100, add=60, mul=40)}
+    assert jaxpr_lint.budget_findings({"k": _counts(100)}, budgets, ["k"]) == []
+    assert jaxpr_lint.budget_findings({"k": _counts(80)}, budgets, ["k"]) == []
+
+
+def test_budget_missing_and_stale_fail():
+    findings = jaxpr_lint.budget_findings(
+        {"new": _counts(10)}, {"gone": _counts(5)}, ["new"]
+    )
+    rules = sorted((f.symbol, f.rule) for f in findings)
+    assert rules == [("gone", "jaxpr-budget"), ("new", "jaxpr-budget")]
+    msgs = {f.symbol: f.message for f in findings}
+    assert "no committed budget baseline" in msgs["new"]
+    assert "stale budget baseline" in msgs["gone"]
+
+
+def test_budget_regression_end_to_end(tmp_path):
+    """Edit the baseline under a real kernel and assert the analyzer
+    fails — the acceptance-criteria regression drill."""
+    _, counts = jaxpr_lint.analyze_kernels(kernels=["fp.add"], budgets=None)
+    real = counts["fp.add"]
+    shrunk = {"fp.add": {"eqns": real["eqns"] - 1, "by_prim": real["by_prim"]}}
+    findings, _ = jaxpr_lint.analyze_kernels(kernels=["fp.add"], budgets=shrunk)
+    grow = [f for f in findings if f.rule == "jaxpr-budget" and f.symbol == "fp.add"]
+    assert grow and "unexplained compile-cost growth" in grow[0].message
+
+
+# -- the x64 import guard ------------------------------------------------------
+
+
+def test_x64_guard_accepts_default_and_rejects_x64():
+    from lighthouse_tpu.crypto.bls import jax_backend
+
+    jax_backend.assert_x64_disabled()  # tier-1 config: x64 off
+    with jax.experimental.enable_x64():
+        with pytest.raises(RuntimeError, match="x64"):
+            jax_backend.assert_x64_disabled()
+
+
+# -- the tree gate (tier-1 teeth) ----------------------------------------------
+
+
+def test_fast_tier_kernels_proven_overflow_free_within_budget():
+    """Every fast-tier registered kernel is PROVEN int32-overflow-free from
+    the canonical-limb precondition, int64/float/host-sync-free, unroll-
+    free, and within its committed primitive-count budget. This is the gate
+    the ROADMAP-1 kernel rewrite (windowed mul, Karabina squaring,
+    batch-affine) lands against."""
+    budgets = jaxpr_lint.load_budgets()
+    assert budgets, "scripts/jaxpr_budgets.json missing — run --update-budgets"
+    findings, counts = jaxpr_lint.analyze_kernels(tiers=("fast",), budgets=budgets)
+    assert not findings, "\n".join(f.format() for f in findings)
+    # the registry actually covered the kernel surface (guards accidental
+    # registry emptiness making this gate vacuous)
+    assert len(counts) >= 15
+    for family in ("fp.", "tower.", "curve.", "pairing.", "h2c."):
+        assert any(k.startswith(family) for k in counts), family
+
+
+@pytest.mark.slow
+def test_all_tiers_kernels_proven_overflow_free_within_budget():
+    """Nightly tier: the slow composites too (Miller loop, final exp, full
+    hash-to-G2, verify_pipeline_local at two (S, K) bucket shapes)."""
+    budgets = jaxpr_lint.load_budgets()
+    findings, counts = jaxpr_lint.analyze_kernels(
+        tiers=("fast", "slow"), budgets=budgets
+    )
+    assert not findings, "\n".join(f.format() for f in findings)
+    assert set(counts) == set(registry.kernel_names())

@@ -271,6 +271,50 @@ def kernel(x):
     assert len(findings) == 1 and ".item()" in findings[0].message
 
 
+def test_trace_purity_flags_int64_in_traced_code():
+    """The limb kernels assume 32-bit lanes: np.int64 / jnp.int64 /
+    astype('int64') anywhere jit-reachable is a width-assumption break
+    (single-sourced with the jaxpr aval check via WIDE_DTYPE_NAMES)."""
+    src = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    wide = x.astype(jnp.int64)
+    again = x.astype("int64")
+    table = jnp.zeros(4, dtype=np.uint64)
+    return wide + again + table
+"""
+    findings = run_checker(TracePurityChecker(), src)
+    msgs = [f.message for f in findings]
+    assert sum("jnp.int64" in m for m in msgs) == 1
+    assert sum("'int64'" in m for m in msgs) == 1
+    assert sum("np.uint64" in m for m in msgs) == 1
+    assert all(f.symbol == "kernel" for f in findings)
+
+
+def test_trace_purity_allows_int64_in_host_staging():
+    """Host-side packing/staging legitimately uses 64-bit numpy (e.g.
+    fp.limbs_to_int, the uint64 scalar draws) — only jit-reachable code is
+    held to the 32-bit rule."""
+    src = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+def stage(xs):
+    return np.asarray(xs, dtype=np.int64)   # host: fine
+
+def build():
+    def kernel(x):
+        return jnp.sum(x * 2)
+    return jax.jit(kernel)
+"""
+    assert run_checker(TracePurityChecker(), src) == []
+
+
 # -- metric-name ---------------------------------------------------------------
 
 METRIC_BAD = """
