@@ -31,6 +31,13 @@ signing roots and long-lived validator pubkeys. The `staging` scenario
 (--all / --staging) measures that fast path directly: pack + h2c host time
 from the span tree, warm cache vs cold, on a 64-set batch with 8 distinct
 messages, with verdict parity against the pure-Python ref backend.
+
+The `kernel` scenario (--kernel) is a CPU-isolated micro-benchmark of the
+fast-kernel-algebra rewrites: windowed scalar multiplication vs the
+Montgomery ladder, Karabina compressed `_pow_abs_x` vs the plain Fp12
+square-and-multiply chain, and shared-batch-inversion affine conversion vs
+per-group `to_affine` — each pair output-checked before it is timed.
+`scripts/profile_stages.py --kernel` prints the matching stage split.
 """
 
 import json
@@ -297,6 +304,110 @@ def bench_staging(b):
     }
 
 
+def bench_kernel():
+    """#8: kernel-algebra micro-scenario (--kernel) — the three rewritten
+    kernels head-to-head against their previous forms, each as its OWN
+    jitted program on small shapes, pinned to the CPU platform so the
+    comparison isolates the algebra from accelerator dispatch:
+
+      - scalar-mul: 4-bit windowed `scalar_mul_bits` vs the Montgomery
+        ladder (`scalar_mul_bits_ladder`) on an S=8 G1 batch of 64-bit
+        scalars (the RLC shape);
+      - final-exp chain: Karabina compressed `_pow_abs_x` vs the plain
+        square-and-multiply Fp12 chain it replaced;
+      - to-affine: one shared `fp.batch_inv` across the G1+G2 batch vs
+        the two independent inversion chains of per-group `to_affine`.
+
+    Each pair is checked for identical outputs before it is timed."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from lighthouse_tpu.crypto.bls.jax_backend import curve as cv
+    from lighthouse_tpu.crypto.bls.jax_backend import fp, pack, pairing
+    from lighthouse_tpu.crypto.bls.jax_backend.tower import fp12_mul, fp12_sqr, fp2_mul
+    from lighthouse_tpu.crypto.bls.ref.curves import g1_generator, g2_generator
+    from lighthouse_tpu.crypto.bls.ref.pairing import pairing as ref_pairing
+
+    S = 8
+    g1s = [g1_generator().mul(3 + 5 * i) for i in range(S)]
+    x, y, inf = (jnp.asarray(a) for a in pack.pack_g1_batch(g1s))
+    P = cv.from_affine(cv.FP, x, y, inf)
+    bits = jnp.asarray(np.random.default_rng(0).integers(0, 2, size=(S, 64), dtype=np.int32))
+
+    def ok(fn):
+        # adapter for _timed: sync and return truthy
+        def run():
+            jax.block_until_ready(fn())
+            return True
+
+        return run
+
+    windowed = jax.jit(lambda p, r: cv.scalar_mul_bits(cv.FP, p, r))
+    ladder = jax.jit(lambda p, r: cv.scalar_mul_bits_ladder(cv.FP, p, r))
+    w_aff = cv.to_affine(cv.FP, windowed(P, bits))
+    l_aff = cv.to_affine(cv.FP, ladder(P, bits))
+    assert all(np.array_equal(a, b) for a, b in zip(map(np.asarray, w_aff), map(np.asarray, l_aff)))
+    t_sm_new = _timed(ok(lambda: windowed(P, bits)), reps=3)
+    t_sm_old = _timed(ok(lambda: ladder(P, bits)), reps=3)
+
+    e = jnp.asarray(pack.pack_fp12_el(ref_pairing(g1_generator(), g2_generator())))
+
+    def naive_pow(gg):
+        acc = gg
+        for bit in pairing._ABS_X_BITS_MSB[1:]:
+            acc = fp12_sqr(acc)
+            if bit:
+                acc = fp12_mul(acc, gg)
+        return acc
+
+    kar = jax.jit(pairing._pow_abs_x)
+    naive = jax.jit(naive_pow)
+    assert np.array_equal(np.asarray(kar(e)), np.asarray(naive(e)))
+    t_fe_new = _timed(ok(lambda: kar(e)), reps=3)
+    t_fe_old = _timed(ok(lambda: naive(e)), reps=3)
+
+    g2s = [g2_generator().mul(2 + 3 * i) for i in range(S + 1)]
+    qx, qy, qinf = (jnp.asarray(a) for a in pack.pack_g2_batch(g2s))
+    Q = jax.jit(lambda a, b, c: cv.dbl(cv.FP2, cv.from_affine(cv.FP2, a, b, c)))(qx, qy, qinf)
+    P2 = jax.jit(lambda p: cv.dbl(cv.FP, p))(P)
+
+    def separate(p1, q2):
+        return cv.to_affine(cv.FP, p1), cv.to_affine(cv.FP2, q2)
+
+    def shared(p1, q2):
+        z0, z1 = q2.z[..., 0, :], q2.z[..., 1, :]
+        zsq = fp.sqr(jnp.stack([z0, z1]))
+        dens = jnp.concatenate([p1.z, fp.add(zsq[0], zsq[1])], axis=0)
+        inv_all = fp.batch_inv(dens)
+        g1_aff = fp.mul(jnp.stack([p1.x, p1.y]), jnp.broadcast_to(inv_all[:S], (2, S, fp.N_LIMBS)))
+        nm = fp.mul(jnp.stack([z0, z1]), jnp.broadcast_to(inv_all[S:], (2, S + 1, fp.N_LIMBS)))
+        zinv2 = jnp.stack([nm[0], fp.neg(nm[1])], axis=-2)
+        g2_aff = fp2_mul(jnp.stack([q2.x, q2.y]), jnp.broadcast_to(zinv2, (2, S + 1, 2, fp.N_LIMBS)))
+        return g1_aff, g2_aff
+
+    sep = jax.jit(separate)
+    shr = jax.jit(shared)
+    (p_ax, p_ay, _), (q_ax, q_ay, _) = sep(P2, Q)
+    g1_aff, g2_aff = shr(P2, Q)
+    assert np.array_equal(np.asarray(g1_aff), np.stack([np.asarray(p_ax), np.asarray(p_ay)]))
+    assert np.array_equal(np.asarray(g2_aff), np.stack([np.asarray(q_ax), np.asarray(q_ay)]))
+    t_aff_new = _timed(ok(lambda: shr(P2, Q)), reps=3)
+    t_aff_old = _timed(ok(lambda: sep(P2, Q)), reps=3)
+
+    return {
+        "metric": "kernel_scalar_mul_speedup",
+        "value": round(t_sm_old / t_sm_new, 2),
+        "unit": "x",
+        "platform": jax.default_backend(),
+        "scalar_mul_ms": {"windowed": round(t_sm_new * 1e3, 2), "ladder": round(t_sm_old * 1e3, 2)},
+        "pow_abs_x_ms": {"karabina": round(t_fe_new * 1e3, 2), "square_multiply": round(t_fe_old * 1e3, 2)},
+        "pow_abs_x_speedup": round(t_fe_old / t_fe_new, 2),
+        "to_affine_ms": {"batch_inv": round(t_aff_new * 1e3, 2), "separate": round(t_aff_old * 1e3, 2)},
+        "to_affine_speedup": round(t_aff_old / t_aff_new, 2),
+    }
+
+
 def bench_epoch_processing():
     """Host-side half of config #5: the epoch-boundary transition at a
     large validator count (SURVEY.md §7 hard part 4 — the reference runs
@@ -385,6 +496,10 @@ def child_main() -> None:
         print(json.dumps(out))
         return
 
+    if "--kernel" in sys.argv and not run_all:
+        print(json.dumps(bench_kernel()))
+        return
+
     results = {}
     if run_all:
         results["config1"] = bench_config1(b)
@@ -448,6 +563,25 @@ def main() -> None:
 
     run_all = [f for f in ("--all", "--staging") if f in sys.argv]
     errors = []
+
+    if "--kernel" in sys.argv and "--all" not in sys.argv:
+        # kernel-algebra micro-scenario: defined as a CPU-isolated
+        # measurement (no accelerator attempt, no tunnel probe)
+        result, err = _run_child(
+            {"JAX_PLATFORMS": "cpu"},
+            int(os.environ.get("BENCH_KERNEL_TIMEOUT", 2400)),
+            ("--kernel",),
+            drop_env=("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"),
+        )
+        if result is None:
+            result = {
+                "metric": "kernel_scalar_mul_speedup",
+                "value": 0.0,
+                "unit": "x",
+                "error": f"kernel scenario: {err}",
+            }
+        print(json.dumps(result))
+        return
 
     # Fast pre-probe: a wedged tunnel hangs the child's jax import, so a
     # 90 s device-list probe decides whether the accelerator attempts are

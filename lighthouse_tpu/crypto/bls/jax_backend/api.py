@@ -210,7 +210,7 @@ def verify_pipeline_local(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits):
 
     Single-chip callers multiply nothing: final_exponentiation(partial).
     """
-    from . import h2c, pairing
+    from . import fp, h2c, pairing
     from .curve import (
         FP,
         FP2,
@@ -222,8 +222,8 @@ def verify_pipeline_local(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits):
         neg as p_neg,
         psi,
         scalar_mul_bits,
-        to_affine,
     )
+    from .tower import fp2_mul
     from .pack import G1_GEN_X_L, G1_GEN_NEG_Y_L
 
     S, K = pk_inf.shape
@@ -257,15 +257,33 @@ def verify_pipeline_local(pk_x, pk_y, pk_inf, sig_x, sig_y, sig_inf, u, r_bits):
     sig_acc = _tree_fold(FP2, rsig, axis=0)
 
     # 6. S+1 Miller pairs: (r_i aggpk_i, H_i) and (-g1, local sig_acc).
-    pk_ax, pk_ay, pk_ainf = to_affine(FP, r_pk)
-    h_ax, h_ay, h_ainf = to_affine(FP2, H)
-    sa_x, sa_y, sa_inf = to_affine(FP2, sig_acc)
-    px = jnp.concatenate([pk_ax, jnp.asarray(G1_GEN_X_L)[None]], axis=0)
-    py = jnp.concatenate([pk_ay, jnp.asarray(G1_GEN_NEG_Y_L)[None]], axis=0)
+    #    Batch-affine: every denominator reduces to one Fp value — a G1 z
+    #    directly, a G2 z through its norm z0^2 + z1^2 (1/(z0 + z1 u) =
+    #    (z0 - z1 u)/norm) — so all 2S+1 conversions share ONE Fermat
+    #    inversion via fp.batch_inv instead of paying a ~380-squaring chain
+    #    each. Infinity lanes carry z = 0 -> inv0 -> zeroed affine coords,
+    #    byte-identical to per-point to_affine.
+    g2_z = jnp.concatenate([H.z, sig_acc.z[None]], axis=0)  # (S+1, 2, 32)
+    z0, z1 = g2_z[..., 0, :], g2_z[..., 1, :]
+    zsq = fp.sqr(jnp.stack([z0, z1]))
+    dens = jnp.concatenate([r_pk.z, fp.add(zsq[0], zsq[1])], axis=0)
+    inv_all = fp.batch_inv(dens)  # (2S+1, 32)
+    g1_aff = fp.mul(
+        jnp.stack([r_pk.x, r_pk.y]), jnp.broadcast_to(inv_all[:S], (2, S, 32))
+    )
+    pk_ainf = is_infinity(FP, r_pk)
+    nm = fp.mul(jnp.stack([z0, z1]), jnp.broadcast_to(inv_all[S:], (2, S + 1, 32)))
+    zinv2 = jnp.stack([nm[0], fp.neg(nm[1])], axis=-2)  # conj(z) * norm^-1
+    g2_aff = fp2_mul(
+        jnp.stack([jnp.concatenate([H.x, sig_acc.x[None]], axis=0),
+                   jnp.concatenate([H.y, sig_acc.y[None]], axis=0)]),
+        jnp.broadcast_to(zinv2, (2, S + 1, 2, 32)),
+    )
+    px = jnp.concatenate([g1_aff[0], jnp.asarray(G1_GEN_X_L)[None]], axis=0)
+    py = jnp.concatenate([g1_aff[1], jnp.asarray(G1_GEN_NEG_Y_L)[None]], axis=0)
     p_in = jnp.concatenate([pk_ainf, jnp.zeros(1, bool)])
-    qx = jnp.concatenate([h_ax, sa_x[None]], axis=0)
-    qy = jnp.concatenate([h_ay, sa_y[None]], axis=0)
-    q_in = jnp.concatenate([h_ainf, sa_inf[None]])
+    qx, qy = g2_aff[0], g2_aff[1]
+    q_in = is_infinity(FP2, Proj(qx, qy, g2_z))
 
     f = pairing.miller_loop(px, py, p_in, qx, qy, q_in)
     partial = pairing.product_reduce(f)

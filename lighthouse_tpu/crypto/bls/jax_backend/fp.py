@@ -337,11 +337,17 @@ def _pow_bits_windowed(base, bits: np.ndarray, mul_fn, sqr_fn, one, window: int 
     fatter steps are the lever — generic over the field ops so Fp and Fp2
     share the structure."""
     chunks = jnp.asarray(_window_chunks(bits, window), dtype=jnp.int32)
-    # table[j] = base^j, j in [0, 2^window)
-    table = [one, base]
-    for _ in range(2, 1 << window):
-        table.append(mul_fn(table[-1], base))
-    table = jnp.stack(table)
+    # table[j] = base^j, j in [0, 2^window): one mul *instantiation* (a scan
+    # collecting ys) instead of 2^window - 2 unrolled muls — the unrolled
+    # form dominated this kernel's graph size (fp.inv was ~8.6k eqns, most
+    # of it table build), which every inversion-bearing kernel inherited.
+
+    def table_step(t, _):
+        t = mul_fn(t, base)
+        return t, t
+
+    _, tail = lax.scan(table_step, base, None, length=(1 << window) - 2)
+    table = jnp.concatenate([jnp.stack([one, base]), tail])
 
     def step(acc, chunk):
         for _ in range(window):
@@ -364,6 +370,37 @@ def inv(a: jnp.ndarray) -> jnp.ndarray:
     """a^-1 via Fermat (a^(p-2)); returns 0 for input 0 ("inv0" semantics,
     which is exactly what the branch-free SSWU map needs, RFC 9380 §4)."""
     return _pow_bits(a, _INV_EXP_BITS)
+
+
+def batch_inv(a: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery batch inversion over the LEADING axis: N inversions for
+    the price of one Fermat chain plus 3(N-1) multiplications.
+
+    Zero entries are masked to one through the prefix products and re-masked
+    to zero at the end, so each lane keeps `inv`'s inv0 semantics exactly
+    (0 -> 0) and zeros never poison the shared product. The backward pass
+    computes inv_i = t * prefix_{i-1} and the next carry t * a_i as ONE
+    2-stacked mul per step."""
+    zero_mask = is_zero(a)
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape)
+    am = select(zero_mask, one, a)
+
+    def fwd(acc, x):
+        acc = mul(acc, x)
+        return acc, acc
+
+    total, tail = lax.scan(fwd, am[0], am[1:])
+    prefix = jnp.concatenate([am[:1], tail])  # prefix[i] = prod_{j<=i} am[j]
+    t0 = inv(total)
+
+    def bwd(t, xs):
+        pm1, ai = xs
+        u = mul(jnp.stack([t, t]), jnp.stack([pm1, ai]))
+        return u[1], u[0]  # carry t*a_i backward, emit inv_i = t*prefix_{i-1}
+
+    t, invs_tail = lax.scan(bwd, t0, (prefix[:-1], am[1:]), reverse=True)
+    invs = jnp.concatenate([t[None], invs_tail])
+    return select(zero_mask, jnp.zeros_like(a), invs)
 
 
 def sqrt_candidate(a: jnp.ndarray) -> jnp.ndarray:
@@ -448,3 +485,8 @@ def _spec_redc():
 @_reg.register("fp.inv")
 def _spec_inv():
     return inv, (_limb_vec(),), [_reg.LIMB]
+
+
+@_reg.register("fp.batch_inv")
+def _spec_batch_inv():
+    return batch_inv, (np.zeros((4, N_LIMBS), np.int32),), [_reg.LIMB]

@@ -358,6 +358,163 @@ def fp12_conj(a):
     return fp12(a[..., 0, :, :, :], fp6_neg(a[..., 1, :, :, :]))
 
 
+# -- Karabina compressed cyclotomic squaring -----------------------------------
+#
+# For f in the cyclotomic subgroup (where the final-exp easy part puts every
+# value), four of the six tower coefficients determine f, and squaring acts
+# directly on the compressed vector (Karabina 2010 / Granger–Scott 2009):
+# with tower f = (a0 + a1 v + a2 v^2) + (b0 + b1 v + b2 v^2) w and the
+# g-coordinates (g2, g3, g4, g5) = (b0, a2, a1, b2) — flat w-indices
+# [1, 4, 2, 5], host-verified against the reference tower —
+#
+#   h2 = 2 g2 + 6 xi g4 g5
+#   h3 = 3 (g4^2 + xi g5^2) - 2 g3
+#   h4 = 3 (g2^2 + xi g3^2) - 2 g4
+#   h5 = 2 g5 + 6 g2 g3
+#
+# i.e. 4 Fp2 squares + 2 Fp2 products = 14 Fp column-product rows per
+# squaring instead of _flat_sqr's 63 — the per-step win of the compressed
+# final-exp chains. Decompression (one per 63-step chain, batched over the
+# chain's checkpoints so it costs ONE shared Fp inversion):
+#
+#   g1 = (xi g5^2 + 3 g4^2 - 2 g3) / (4 g2)          g2 != 0
+#      = 2 g4 g5 / g3                                g2 == 0
+#   g0 = (2 g1^2 + g2 g5 - 3 g3 g4) xi + 1           (g2 g5 = 0 when g2 = 0)
+#
+# The g2 = 0, g3 = 0 corner is the identity: inv0 gives g1 = 0, g0 = 1.
+
+KARABINA_FLAT_IDX = np.array([1, 4, 2, 5])  # flat k of [g2, g3, g4, g5]
+
+# 2p * 2^384 as 64 columns (limbs of 2p at columns 32..63): the value lift
+# that keeps "- 2 g * 2^384" terms nonnegative before redc.
+_OFF_2PR = np.concatenate(
+    [np.zeros(fp.N_LIMBS, np.int32), fp.int_to_limbs(2 * P)]
+)
+
+
+def karabina_compress(a):
+    """Tower Fp12 (..., 2, 3, 2, L) -> compressed (..., 4, 2, L) =
+    [g2, g3, g4, g5]. Only meaningful for cyclotomic elements."""
+    return _to_flat(a)[..., KARABINA_FLAT_IDX, :, :]
+
+
+def karabina_sqr(c):
+    """One compressed cyclotomic squaring, canonical limbs in / out.
+
+    Lazy evaluation: ONE stacked poly over the 14 product rows, the h2..h5
+    combinations formed column-wise with small static coefficients, ONE
+    stacked redc(mult=7) over the 8 output Fp rows. Value-bound sketch
+    (p^2 < p*2^384/8): every product value < 3p^2 (c0 rows carry the +2p^2
+    lift), so the worst row is h2_0 < 2p*2^384 + 30p^2 < 5.75 * p*2^384,
+    and the "-2g" rows add the 2p*2^384 lift before subtracting the shifted
+    canonical coefficient — all rows nonnegative and < 7p*2^384. Columns:
+    pass1 leaves product columns < 2^19, the combinations scale by <= 6 and
+    sum <= 3 terms plus sub-2^12 lift/shift digits, staying far under the
+    redc ~1.5*2^30 input cap (proven by the jaxpr interval analyzer)."""
+    a0, a1 = c[..., 0, :], c[..., 1, :]  # (..., 4, 32): per-g components
+    s = fp.pass1(a0 + a1)
+    d = fp.sub(a0, a1)  # one stacked canonical subtraction
+
+    def g0(i):
+        return a0[..., i, :]
+
+    def g1(i):
+        return a1[..., i, :]
+
+    # rows 0..7: the four Fp2 squares ((s)(d) and (a0)(a1) per g);
+    # rows 8..10: B45 = g4*g5 Karatsuba; rows 11..13: B23 = g2*g3.
+    L = jnp.stack(
+        [s[..., 0, :], g0(0), s[..., 1, :], g0(1), s[..., 2, :], g0(2),
+         s[..., 3, :], g0(3), g0(2), g1(2), s[..., 2, :], g0(0), g1(0),
+         s[..., 0, :]],
+        axis=-2,
+    )
+    R = jnp.stack(
+        [d[..., 0, :], g1(0), d[..., 1, :], g1(1), d[..., 2, :], g1(2),
+         d[..., 3, :], g1(3), g0(3), g1(3), s[..., 3, :], g0(1), g1(1),
+         s[..., 1, :]],
+        axis=-2,
+    )
+    t = fp._pad_to(fp.poly(L, R), 64)  # (..., 14, 64)
+    off2pp = jnp.asarray(fp.OFF_2PP)
+    sq0 = t[..., 0:8:2, :]  # (a0+a1)(a0-a1) per g: real part of g^2
+    sq1 = 2 * t[..., 1:8:2, :]  # 2 a0 a1 per g: imag part of g^2
+    b45_0 = t[..., 8, :] - t[..., 9, :] + off2pp
+    b45_1 = t[..., 10, :] - (t[..., 8, :] + t[..., 9, :])
+    b23_0 = t[..., 11, :] - t[..., 12, :] + off2pp
+    b23_1 = t[..., 13, :] - (t[..., 11, :] + t[..., 12, :])
+    cc = fp.pass1(
+        jnp.concatenate(
+            [sq0, sq1, jnp.stack([b45_0, b45_1, b23_0, b23_1], axis=-2)],
+            axis=-2,
+        )
+    )  # rows: [S2_0,S3_0,S4_0,S5_0, S2_1,S3_1,S4_1,S5_1, B45_0,B45_1,B23_0,B23_1]
+    S0 = lambda i: cc[..., i, :]
+    S1 = lambda i: cc[..., 4 + i, :]
+    b45 = (cc[..., 8, :], cc[..., 9, :])
+    b23 = (cc[..., 10, :], cc[..., 11, :])
+
+    # canonical coefficients shifted to the 2^384 boundary (g * R as columns)
+    gR = jnp.concatenate([jnp.zeros_like(c), c], axis=-1)  # (..., 4, 2, 64)
+    off2pr = jnp.asarray(_OFF_2PR)
+
+    xi5_0 = S0(3) - S1(3) + off2pp  # xi * g5^2, component 0 (+2p^2 lift)
+    xi5_1 = S0(3) + S1(3)
+    xi3_0 = S0(1) - S1(1) + off2pp
+    xi3_1 = S0(1) + S1(1)
+    h2_0 = 2 * gR[..., 0, 0, :] + 6 * (b45[0] - b45[1]) + 6 * off2pp
+    h2_1 = 2 * gR[..., 0, 1, :] + 6 * (b45[0] + b45[1])
+    h3_0 = 3 * (S0(2) + xi5_0) + off2pr - 2 * gR[..., 1, 0, :]
+    h3_1 = 3 * (S1(2) + xi5_1) + off2pr - 2 * gR[..., 1, 1, :]
+    h4_0 = 3 * (S0(0) + xi3_0) + off2pr - 2 * gR[..., 2, 0, :]
+    h4_1 = 3 * (S1(0) + xi3_1) + off2pr - 2 * gR[..., 2, 1, :]
+    h5_0 = 2 * gR[..., 3, 0, :] + 6 * b23[0]
+    h5_1 = 2 * gR[..., 3, 1, :] + 6 * b23[1]
+    h = jnp.stack(
+        [jnp.stack([h2_0, h2_1], axis=-2), jnp.stack([h3_0, h3_1], axis=-2),
+         jnp.stack([h4_0, h4_1], axis=-2), jnp.stack([h5_0, h5_1], axis=-2)],
+        axis=-3,
+    )
+    return fp.redc(h, mult=7)
+
+
+def karabina_decompress(c):
+    """Compressed (..., 4, 2, L) -> tower Fp12, sharing ONE Fp inversion
+    across the LEADING axis (callers batch a whole chain's checkpoints).
+    Branch-free g2 = 0 handling via select of numerator/denominator; the
+    all-zero compressed identity decompresses to one through inv0."""
+    g2_, g3_, g4_, g5_ = (c[..., i, :, :] for i in range(4))
+    sq = fp2_sqr(jnp.stack([g5_, g4_]))
+    pr = fp2_mul(jnp.stack([g4_, g3_, g2_]), jnp.stack([g5_, g4_, g5_]))
+    s5, s4 = sq[0], sq[1]
+    b45, g3g4, g2g5 = pr[0], pr[1], pr[2]
+    s4_3 = fp.add(fp.add(s4, s4), s4)
+    num1 = fp.sub(fp.add(fp2_mul_by_nonresidue(s5), s4_3), fp.add(g3_, g3_))
+    num2 = fp.add(b45, b45)
+    g2nz = ~fp2_is_zero(g2_)
+    four_g2 = fp.add(fp.add(g2_, g2_), fp.add(g2_, g2_))
+    num = fp2_select(g2nz, num1, num2)
+    den = fp2_select(g2nz, four_g2, g3_)
+    # shared inversion: 1/(d0 + d1 u) = (d0 - d1 u) / (d0^2 + d1^2), with the
+    # norms of every lane riding one fp.batch_inv (one Fermat chain total)
+    d0, d1 = den[..., 0, :], den[..., 1, :]
+    nsq = fp.sqr(jnp.stack([d0, d1]))
+    norm = fp.add(nsq[0], nsq[1])
+    ninv = fp.batch_inv(norm.reshape(-1, fp.N_LIMBS)).reshape(norm.shape)
+    dm = fp.mul(jnp.stack([d0, d1]), jnp.broadcast_to(ninv, (2, *ninv.shape)))
+    dinv = jnp.stack([dm[0], fp.neg(dm[1])], axis=-2)
+    g1_ = fp2_mul(num, dinv)
+    s1 = fp2_sqr(g1_)
+    g0_ = fp.add(
+        fp2_mul_by_nonresidue(
+            fp.sub(fp.add(fp.add(s1, s1), g2g5), fp.add(fp.add(g3g4, g3g4), g3g4))
+        ),
+        fp2_one(c.shape[:-3]),
+    )
+    flat = jnp.stack([g0_, g2_, g4_, g1_, g3_, g5_], axis=-3)  # k = 0..5
+    return _from_flat(flat)
+
+
 def _omega_constants():
     """omega in Fp with omega^2 + omega + 1 = 0 (primitive cube root of
     unity), via sqrt(-3) (p = 3 mod 4). Host-side, Montgomery-packed."""
@@ -490,3 +647,17 @@ def _spec_fp12_mul_sparse():
 @_reg.register("tower.fp12_inv", tier="slow")
 def _spec_fp12_inv():
     return fp12_inv, (_f12(),), [_reg.LIMB]
+
+
+def _kar():
+    return np.zeros((4, 2, fp.N_LIMBS), np.int32)
+
+
+@_reg.register("tower.karabina_sqr")
+def _spec_karabina_sqr():
+    return karabina_sqr, (_kar(),), [_reg.LIMB]
+
+
+@_reg.register("tower.karabina_decompress")
+def _spec_karabina_decompress():
+    return karabina_decompress, (_kar(),), [_reg.LIMB]

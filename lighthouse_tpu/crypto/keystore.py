@@ -14,7 +14,14 @@ import secrets
 import unicodedata
 import uuid as _uuid
 
-from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+try:
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    _HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # container without the wheel: pure fallback
+    _HAVE_CRYPTOGRAPHY = False
+
+from . import aes as _aes
 
 
 class KeystoreError(ValueError):
@@ -48,9 +55,11 @@ def _kdf(password: bytes, kdf: dict) -> bytes:
 
 
 def _aes128ctr(key: bytes, iv: bytes, data: bytes) -> bytes:
-    cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
-    enc = cipher.encryptor()
-    return enc.update(data) + enc.finalize()
+    if _HAVE_CRYPTOGRAPHY:
+        cipher = Cipher(algorithms.AES(key), modes.CTR(iv))
+        enc = cipher.encryptor()
+        return enc.update(data) + enc.finalize()
+    return _aes.aes128_ctr(key, iv, data)
 
 
 def encrypt(

@@ -16,14 +16,20 @@ from __future__ import annotations
 
 import base64
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
 
+    _HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # container without the wheel: pure fallback
+    _HAVE_CRYPTOGRAPHY = False
+
+from ..crypto import secp256k1 as _secp
 from .keccak import keccak256
 
 MAX_ENR_SIZE = 300
@@ -106,11 +112,15 @@ def _rlp_decode_one(data: bytes):
 # -- secp256k1 identity scheme -------------------------------------------------
 
 
-def generate_key() -> ec.EllipticCurvePrivateKey:
-    return ec.generate_private_key(ec.SECP256K1())
+def generate_key() -> "ec.EllipticCurvePrivateKey":
+    if _HAVE_CRYPTOGRAPHY:
+        return ec.generate_private_key(ec.SECP256K1())
+    return _secp.PrivateKey.generate()
 
-def private_key_from_bytes(raw: bytes) -> ec.EllipticCurvePrivateKey:
-    return ec.derive_private_key(int.from_bytes(raw, "big"), ec.SECP256K1())
+def private_key_from_bytes(raw: bytes) -> "ec.EllipticCurvePrivateKey":
+    if _HAVE_CRYPTOGRAPHY:
+        return ec.derive_private_key(int.from_bytes(raw, "big"), ec.SECP256K1())
+    return _secp.PrivateKey(int.from_bytes(raw, "big"))
 
 
 def compressed_pubkey(key) -> bytes:
@@ -120,8 +130,10 @@ def compressed_pubkey(key) -> bytes:
     return bytes([0x02 + (nums.y & 1)]) + nums.x.to_bytes(32, "big")
 
 
-def pubkey_from_compressed(data: bytes) -> ec.EllipticCurvePublicKey:
-    return ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256K1(), data)
+def pubkey_from_compressed(data: bytes) -> "ec.EllipticCurvePublicKey":
+    if _HAVE_CRYPTOGRAPHY:
+        return ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256K1(), data)
+    return _secp.PublicKey.from_compressed(data)
 
 
 def node_id_from_pubkey(pub: ec.EllipticCurvePublicKey) -> bytes:
@@ -132,20 +144,25 @@ def node_id_from_pubkey(pub: ec.EllipticCurvePublicKey) -> bytes:
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
 
 
-def _sign_v4(key: ec.EllipticCurvePrivateKey, content: bytes) -> bytes:
+def _sign_v4(key: "ec.EllipticCurvePrivateKey", content: bytes) -> bytes:
     digest = keccak256(content)
-    der = key.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
-    r, s = decode_dss_signature(der)
+    if isinstance(key, _secp.PrivateKey):
+        r, s = key.sign_digest(digest)
+    else:
+        der = key.sign(digest, ec.ECDSA(Prehashed(hashes.SHA256())))
+        r, s = decode_dss_signature(der)
     if s > _N // 2:  # low-s normalization (EIP-778 convention)
         s = _N - s
     return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
 
-def _verify_v4(pub: ec.EllipticCurvePublicKey, signature: bytes, content: bytes) -> bool:
+def _verify_v4(pub: "ec.EllipticCurvePublicKey", signature: bytes, content: bytes) -> bool:
     if len(signature) != 64:
         return False
     r = int.from_bytes(signature[:32], "big")
     s = int.from_bytes(signature[32:], "big")
+    if isinstance(pub, _secp.PublicKey):
+        return pub.verify_digest(r, s, keccak256(content))
     try:
         der = encode_dss_signature(r, s)
         pub.verify(der, keccak256(content), ec.ECDSA(Prehashed(hashes.SHA256())))

@@ -25,6 +25,13 @@ Run: python scripts/profile_stages.py   (on the bench platform)
          counters a /metrics scrape would show. Host-only — no device
          kernels run. Env: PROFILE_STAGING_SETS (64),
          PROFILE_STAGING_MSGS (8), PROFILE_REPS (5).
+     python scripts/profile_stages.py --kernel
+         fast-kernel-algebra stage split, pinned to CPU (matching
+         `bench.py --kernel`): windowed scalar-mul vs Montgomery ladder,
+         Karabina compressed pow_abs_x vs plain Fp12 square-and-multiply,
+         batch-inversion affine conversion vs per-group to_affine — each
+         its own jitted program, output-checked before timing.
+         Env: PROFILE_KERNEL_SETS (8), PROFILE_REPS (5).
      python scripts/profile_stages.py --opcounts
          per-kernel jaxpr primitive counts from the analyzer registry
          (trace-only, no device) next to the committed budget baseline —
@@ -218,6 +225,105 @@ def staging_main() -> None:
         )
 
 
+def kernel_main() -> None:
+    """--kernel: stage split of the fast-kernel-algebra rewrites, pinned to
+    the CPU platform (matching `bench.py --kernel`): windowed scalar-mul vs
+    the Montgomery ladder, Karabina compressed `_pow_abs_x` vs the plain
+    Fp12 square-and-multiply chain, and shared-batch-inversion affine
+    conversion vs per-group `to_affine`, each as its own jitted program.
+    Every pair is output-checked before it is timed. Env: PROFILE_KERNEL_SETS
+    (8), PROFILE_REPS (5)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+    from lighthouse_tpu.common.tracing import TRACER
+    from lighthouse_tpu.crypto.bls.jax_backend import curve as cv
+    from lighthouse_tpu.crypto.bls.jax_backend import fp, pack, pairing
+    from lighthouse_tpu.crypto.bls.jax_backend.tower import fp12_mul, fp12_sqr, fp2_mul
+    from lighthouse_tpu.crypto.bls.ref.curves import g1_generator, g2_generator
+    from lighthouse_tpu.crypto.bls.ref.pairing import pairing as ref_pairing
+
+    S = int(os.environ.get("PROFILE_KERNEL_SETS", "8"))
+    print(f"platform={jax.default_backend()} n_points={S} (kernel-algebra split)",
+          flush=True)
+
+    g1s = [g1_generator().mul(3 + 5 * i) for i in range(S)]
+    x, y, inf = (jnp.asarray(a) for a in pack.pack_g1_batch(g1s))
+    P = cv.from_affine(cv.FP, x, y, inf)
+    bits = jnp.asarray(np.random.default_rng(0).integers(0, 2, size=(S, 64), dtype=np.int32))
+
+    windowed = jax.jit(lambda p, r: cv.scalar_mul_bits(cv.FP, p, r))
+    ladder = jax.jit(lambda p, r: cv.scalar_mul_bits_ladder(cv.FP, p, r))
+    w_aff = cv.to_affine(cv.FP, windowed(P, bits))
+    l_aff = cv.to_affine(cv.FP, ladder(P, bits))
+    assert all(np.array_equal(a, b) for a, b in zip(map(np.asarray, w_aff), map(np.asarray, l_aff)))
+    t_w = med(lambda: jax.block_until_ready(windowed(P, bits)), "kernel_scalar_mul_windowed")
+    t_l = med(lambda: jax.block_until_ready(ladder(P, bits)), "kernel_scalar_mul_ladder")
+    print(f"scalar-mul windowed       {t_w * 1e3:9.2f} ms", flush=True)
+    print(f"scalar-mul ladder         {t_l * 1e3:9.2f} ms   ({t_l / t_w:.2f}x)", flush=True)
+
+    e = jnp.asarray(pack.pack_fp12_el(ref_pairing(g1_generator(), g2_generator())))
+
+    def naive_pow(gg):
+        acc = gg
+        for bit in pairing._ABS_X_BITS_MSB[1:]:
+            acc = fp12_sqr(acc)
+            if bit:
+                acc = fp12_mul(acc, gg)
+        return acc
+
+    kar = jax.jit(pairing._pow_abs_x)
+    naive = jax.jit(naive_pow)
+    assert np.array_equal(np.asarray(kar(e)), np.asarray(naive(e)))
+    t_k = med(lambda: jax.block_until_ready(kar(e)), "kernel_pow_abs_x_karabina")
+    t_n = med(lambda: jax.block_until_ready(naive(e)), "kernel_pow_abs_x_sqr_mul")
+    print(f"final-exp chain karabina  {t_k * 1e3:9.2f} ms", flush=True)
+    print(f"final-exp chain sqr-mul   {t_n * 1e3:9.2f} ms   ({t_n / t_k:.2f}x)", flush=True)
+
+    g2s = [g2_generator().mul(2 + 3 * i) for i in range(S + 1)]
+    qx, qy, qinf = (jnp.asarray(a) for a in pack.pack_g2_batch(g2s))
+    Q = jax.jit(lambda a, b, c: cv.dbl(cv.FP2, cv.from_affine(cv.FP2, a, b, c)))(qx, qy, qinf)
+    P2 = jax.jit(lambda p: cv.dbl(cv.FP, p))(P)
+
+    def separate(p1, q2):
+        return cv.to_affine(cv.FP, p1), cv.to_affine(cv.FP2, q2)
+
+    def shared(p1, q2):
+        z0, z1 = q2.z[..., 0, :], q2.z[..., 1, :]
+        zsq = fp.sqr(jnp.stack([z0, z1]))
+        dens = jnp.concatenate([p1.z, fp.add(zsq[0], zsq[1])], axis=0)
+        inv_all = fp.batch_inv(dens)
+        g1_aff = fp.mul(jnp.stack([p1.x, p1.y]), jnp.broadcast_to(inv_all[:S], (2, S, fp.N_LIMBS)))
+        nm = fp.mul(jnp.stack([z0, z1]), jnp.broadcast_to(inv_all[S:], (2, S + 1, fp.N_LIMBS)))
+        zinv2 = jnp.stack([nm[0], fp.neg(nm[1])], axis=-2)
+        g2_aff = fp2_mul(jnp.stack([q2.x, q2.y]), jnp.broadcast_to(zinv2, (2, S + 1, 2, fp.N_LIMBS)))
+        return g1_aff, g2_aff
+
+    sep = jax.jit(separate)
+    shr = jax.jit(shared)
+    (p_ax, p_ay, _), (q_ax, q_ay, _) = sep(P2, Q)
+    g1_aff, g2_aff = shr(P2, Q)
+    assert np.array_equal(np.asarray(g1_aff), np.stack([np.asarray(p_ax), np.asarray(p_ay)]))
+    assert np.array_equal(np.asarray(g2_aff), np.stack([np.asarray(q_ax), np.asarray(q_ay)]))
+    t_s = med(lambda: jax.block_until_ready(shr(P2, Q)), "kernel_to_affine_batch_inv")
+    t_p = med(lambda: jax.block_until_ready(sep(P2, Q)), "kernel_to_affine_separate")
+    print(f"to-affine batch_inv       {t_s * 1e3:9.2f} ms", flush=True)
+    print(f"to-affine separate        {t_p * 1e3:9.2f} ms   ({t_p / t_s:.2f}x)", flush=True)
+
+    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
+    for stage, rec in TRACER.stage_report().items():
+        print(
+            f"  {stage:28s} n={rec['count']:3d}"
+            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
+            f"  total={rec['total_s'] * 1e3:9.2f} ms",
+            flush=True,
+        )
+
+
 def print_opcounts() -> None:
     """--opcounts: the analyzer registry's per-kernel primitive counts vs
     the committed baseline (scripts/jaxpr_budgets.json) — the compile-cost
@@ -392,6 +498,12 @@ if __name__ == "__main__":
         coalesce_main()
     elif "--staging" in sys.argv:
         staging_main()
+    elif "--kernel" in sys.argv:
+        # kernel-algebra split is defined as a CPU-isolated measurement
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+        kernel_main()
     elif sys.argv[1:] == ["--opcounts"]:
         # standalone table is trace-only: pin the (uninitialized) backend to
         # CPU so trace constants never ride the tunnelled device link

@@ -87,8 +87,12 @@ def test_wallet_derives_sequential_validators():
 
 def test_lockfile_excludes_second_holder(tmp_path):
     """common/lockfile semantics (flock-backed): a held lock excludes
-    others atomically; a dead holder's leftover FILE does not block (the
-    kernel released its lock with the process); release tidies up."""
+    others atomically; release NEVER unlinks (removing the path lets one
+    process lock an orphaned inode while another locks a fresh file at the
+    same path — two holders); a dead holder's leftover FILE does not block
+    (the kernel released its lock with the process)."""
+    import os
+
     from lighthouse_tpu.validator_client.lockfile import Lockfile, LockfileError
 
     path = tmp_path / "voting-keystore.json.lock"
@@ -96,10 +100,10 @@ def test_lockfile_excludes_second_holder(tmp_path):
     with pytest.raises(LockfileError):
         Lockfile(path).acquire()  # held (flock conflict, same process)
     lock.release()
-    assert not path.exists()
+    assert path.exists()  # only the flock is dropped; the path stays
 
     # leftover file from a dead process: no flock holder -> acquirable
     path.write_text("999999999")
     with Lockfile(path):
-        assert path.read_text().strip() != "999999999"
-    assert not path.exists()
+        assert path.read_text().strip() == str(os.getpid())
+    Lockfile(path).acquire().release()  # still acquirable after release

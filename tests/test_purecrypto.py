@@ -1,0 +1,85 @@
+"""Known-answer tests for the pure-Python crypto fallbacks.
+
+crypto/aes.py and crypto/secp256k1.py stand in for the `cryptography`
+wheel when it is absent (as in this container). Every vector here is an
+external published constant — FIPS-197, SP 800-38A, the SEC1 generator,
+and the canonical RFC 6979 secp256k1/SHA-256 nonce — so the fallbacks are
+pinned to the real algorithms, not to themselves. The EIP-778 example
+record in test_discovery.py additionally pins the ENR integration.
+"""
+
+import hashlib
+
+from lighthouse_tpu.crypto import aes
+from lighthouse_tpu.crypto import secp256k1 as sp
+from lighthouse_tpu.network import enr
+
+
+def test_aes128_block_fips197():
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    assert aes.encrypt_block(key, pt) == bytes.fromhex(
+        "69c4e0d86a7b0430d8cdb78070b4c55a"
+    )
+
+
+def test_aes128_ctr_sp800_38a():
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    iv = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+    pt = bytes.fromhex(
+        "6bc1bee22e409f96e93d7e117393172a" "ae2d8a571e03ac9c9eb76fac45af8e51"
+    )
+    ct = aes.aes128_ctr(key, iv, pt)
+    assert ct == bytes.fromhex(
+        "874d6191b620e3261bef6864990db6ce" "9806f66b7970fdff8617187bb9fffdff"
+    )
+    # CTR is an involution; partial final block supported
+    assert aes.aes128_ctr(key, iv, ct) == pt
+    assert aes.aes128_ctr(key, iv, pt[:23]) == ct[:23]
+
+
+def test_secp256k1_generator_and_compression():
+    # SEC1 generator: 1*G compressed, 2*G affine (public constants)
+    assert (
+        sp.PrivateKey(1).public_key().to_compressed().hex()
+        == "0279be667ef9dcbbac55a06295ce870b07029bfcdb2dce28d959f2815b16f81798"
+    )
+    two_g = sp._mul(2, sp.GX, sp.GY)
+    assert two_g == (
+        0xC6047F9441ED7D6D3045406E95C07CD85C778E4B8CEF3CA7ABAC09B95C709EE5,
+        0x1AE168FEA63DC339A3C58419466CEAEEF7F632653266D0E1236431A950CFE52A,
+    )
+    pub = sp.PrivateKey(2).public_key()
+    rt = sp.PublicKey.from_compressed(pub.to_compressed())
+    assert (rt.x, rt.y) == (pub.x, pub.y)
+
+
+def test_secp256k1_rfc6979_nonce_and_sign_verify():
+    # canonical RFC 6979 secp256k1/SHA-256 vector (msg "sample")
+    d = 0xC9AFA9D845BA75166B5C215767B1D6934E50C3DB36E89B127B8A622B120F6721
+    digest = hashlib.sha256(b"sample").digest()
+    k = next(sp._rfc6979_nonces(d, digest))
+    assert k == 0xA6E3C57DD01ABE90086538398355DD4C3B17AA873382B0F24D6129493D8AAD60
+
+    key = sp.PrivateKey(d)
+    r, s = key.sign_digest(digest)
+    pub = key.public_key()
+    assert pub.verify_digest(r, s, digest)
+    assert not pub.verify_digest(r, s, hashlib.sha256(b"other").digest())
+    assert not pub.verify_digest(r, (s + 1) % sp.N, digest)
+    assert not pub.verify_digest(0, s, digest)
+    # determinism: same key + digest -> same signature
+    assert key.sign_digest(digest) == (r, s)
+
+
+def test_enr_build_verify_with_fallback_keys():
+    """ENR signed with the pure key round-trips through text form and
+    verifies; flipping any content byte kills the signature."""
+    key = sp.PrivateKey(0x1CE90C13A64D6A53E4E6AC9F80A4D8A4B3F4F8F6B52E9A36E2127D664A64A201)
+    record = enr.Enr.build(key, seq=7, ip="10.0.0.9", udp=9000, tcp=9001)
+    assert record.verify()
+    rt = enr.Enr.from_text(record.to_text())
+    assert rt == record and rt.node_id() == record.node_id()
+
+    tampered = enr.Enr(record.seq + 1, record.pairs, record.signature)
+    assert not tampered.verify()
