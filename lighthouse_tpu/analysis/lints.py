@@ -330,6 +330,16 @@ class ThreadHygieneChecker(Checker):
             and node.func.attr == "join"
             and len(_attr_chain(node.func)) >= 2
         }
+        # `for t in threads: t.join()` joins the CONTAINER: propagate the
+        # loop variable's join to the iterated name
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.For)
+                and isinstance(node.target, ast.Name)
+                and node.target.id in joined
+                and isinstance(node.iter, ast.Name)
+            ):
+                joined.add(node.iter.id)
         for call in thread_calls:
             daemon = next((kw for kw in call.keywords if kw.arg == "daemon"), None)
             if daemon is not None and not (
@@ -408,9 +418,17 @@ class ThreadHygieneChecker(Checker):
 
 
 def _assignment_name_for(tree: ast.Module, call: ast.Call) -> str | None:
-    """The `X` of `X = threading.Thread(...)` / `self.X = ...`, else None."""
+    """The `X` of `X = threading.Thread(...)` / `self.X = ...`, else None.
+    A list/generator comprehension building threads counts as assigning the
+    container: `threads = [Thread(...) for ...]` resolves to `threads`."""
     for node in ast.walk(tree):
-        if isinstance(node, ast.Assign) and node.value is call:
+        if isinstance(node, ast.Assign) and (
+            node.value is call
+            or (
+                isinstance(node.value, (ast.ListComp, ast.GeneratorExp))
+                and node.value.elt is call
+            )
+        ):
             for t in node.targets:
                 attr = _self_attr(t)
                 if attr is not None:

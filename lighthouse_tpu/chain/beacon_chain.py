@@ -383,6 +383,19 @@ class BeaconChain:
         self.head_root = self.fork_choice.get_head()
         if self.head_root != old:
             state = self.store.get_state(self.head_root)
+            if not self.fork_choice.is_descendant(old, self.head_root):
+                # the new head is on a different branch: a re-org, not a
+                # chain extension (beacon_chain.rs detects the same way and
+                # feeds metrics::BEACON_REORG_TOTAL + the SSE stream)
+                from ..common.metrics import CHAIN_REORGS_TOTAL
+
+                CHAIN_REORGS_TOTAL.inc()
+                self.events.emit(
+                    "reorg",
+                    slot=int(state.slot) if state else None,
+                    old_head="0x" + old.hex(),
+                    new_head="0x" + self.head_root.hex(),
+                )
             self.events.emit(
                 "head",
                 slot=int(state.slot) if state else None,

@@ -104,8 +104,11 @@ class Client:
         if isinstance(store, HotColdDB) and store.genesis_root is not None:
             genesis_state = store.get_state(store.genesis_root)
             resumed = genesis_state is not None
+        anchor_block = None
         if not resumed and config.checkpoint_url:
-            genesis_state = self._fetch_checkpoint_state(config.checkpoint_url, ctx)
+            genesis_state, anchor_block = self._fetch_checkpoint_state(
+                config.checkpoint_url, ctx
+            )
         elif not resumed and config.genesis_state_path:
             from .types import decode_beacon_state
 
@@ -117,6 +120,13 @@ class Client:
             )
 
         self.chain = BeaconChain(genesis_state, ctx, store=store)
+        if anchor_block is not None:
+            # seed the store with the anchor block itself (checkpoint sync
+            # downloads state AND block): backfill walks strictly BELOW the
+            # anchor slot, so without this the anchor is a hole in history
+            msg = anchor_block.message
+            if type(msg).hash_tree_root(msg) == self.chain.genesis_block_root:
+                self.chain.store.put_block(self.chain.genesis_block_root, anchor_block)
         if resumed:
             self._replay_fork_choice(store)
         self.op_pool = OperationPool(ctx)
@@ -150,10 +160,14 @@ class Client:
 
     @staticmethod
     def _fetch_checkpoint_state(url: str, ctx):
-        """Download the trusted node's finalized state (SSZ) and anchor the
-        chain on it. BeaconChain anchors fork choice on any self-consistent
-        state, so a mid-chain finalized state works exactly like genesis —
-        history backfills later via range sync."""
+        """Download the trusted node's finalized state (SSZ) plus the
+        finalized block, and anchor the chain on them. BeaconChain anchors
+        fork choice on any self-consistent state, so a mid-chain finalized
+        state works exactly like genesis — history backfills later via
+        range sync. The block matters too: backfill only fetches slots
+        BELOW the anchor, so the anchor block must come from the trusted
+        node (builder.rs weak-subjectivity boot takes state + block)."""
+        import json as _json
         import urllib.request
 
         with urllib.request.urlopen(
@@ -162,7 +176,22 @@ class Client:
             data = r.read()
         from .types import decode_beacon_state
 
-        return decode_beacon_state(data, ctx.types, ctx.spec)
+        state = decode_beacon_state(data, ctx.types, ctx.spec)
+        anchor_block = None
+        try:
+            with urllib.request.urlopen(
+                f"{url}/eth/v2/beacon/blocks/finalized", timeout=60
+            ) as r:
+                payload = _json.loads(r.read())
+            from .http_api.json_codec import decode
+
+            anchor_block = decode(
+                payload["data"],
+                ctx.types.for_fork(payload["version"]).SignedBeaconBlock,
+            )
+        except Exception:  # noqa: BLE001 — state-only boot still anchors;
+            pass  # the anchor block just stays a (reported) history hole
+        return state, anchor_block
 
     def _replay_fork_choice(self, store: HotColdDB) -> None:
         """Rebuild fork choice from persisted blocks (ClientGenesis::FromStore)."""

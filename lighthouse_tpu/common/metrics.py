@@ -291,6 +291,10 @@ BLS_SETS_TOTAL = REGISTRY.counter(
 BLOCK_IMPORT_SECONDS = REGISTRY.histogram(
     "lighthouse_tpu_block_import_seconds", "Full block import wall time"
 )
+CHAIN_REORGS_TOTAL = REGISTRY.counter(
+    "lighthouse_tpu_chain_reorgs_total",
+    "Head moved to a block that does not descend from the previous head",
+)
 PROCESSOR_QUEUE_DEPTH = REGISTRY.gauge(
     "lighthouse_tpu_processor_queue_depth", "BeaconProcessor total queued events"
 )

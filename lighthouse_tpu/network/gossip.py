@@ -284,10 +284,16 @@ class GossipNode:
             self._promises.pop(mid, None)  # any promise on this id is fulfilled
         if not self._mark_seen(mid):
             return
-        self._remember(mid, topic, frame)
         self._ensure_mesh(topic)
+        # validate BEFORE propagating (gossipsub v1.1 flood-protection):
+        # the app callback's verdict gates forwarding — a `False` return
+        # means the payload failed validation, and relaying it would make
+        # this node look like the attacker to its own mesh peers. Any
+        # other return (None included) accepts the message.
+        if self.deliver(topic, payload, self._peer_id(source)) is False:
+            return
+        self._remember(mid, topic, frame)
         self._push_to_mesh(topic, frame, exclude=source)
-        self.deliver(topic, payload, self._peer_id(source))
 
     def _on_control(self, frame: bytes, source) -> None:
         try:

@@ -13,6 +13,11 @@ from .topics import Topic
 
 
 class LocalNetwork:
+    # fault-injection seam (sim.LinkFaults installs itself here): gossip
+    # deliveries are wrapped in a closure the filter may drop/delay/
+    # duplicate; req-resp paths ask it for a boolean link-up verdict
+    link_filter = None
+
     def __init__(self):
         self.peers: dict[str, object] = {}  # node_id -> NetworkService
 
@@ -21,20 +26,34 @@ class LocalNetwork:
 
     def publish(self, from_id: str, topic: Topic, message) -> None:
         """Gossip: deliver to every peer except the publisher."""
+        fil = self.link_filter
         for node_id, service in self.peers.items():
-            if node_id != from_id:
+            if node_id == from_id:
+                continue
+            if fil is None:
                 service.on_gossip(topic, message)
+            else:
+                fil(from_id, node_id, "gossip", lambda s=service: s.on_gossip(topic, message))
 
     # -- per-peer surface for the sync machines --------------------------------
 
     def peer_ids(self, requester_id: str) -> list[str]:
-        return [nid for nid in self.peers if nid != requester_id]
+        fil = self.link_filter
+        return [
+            nid
+            for nid in self.peers
+            if nid != requester_id
+            and (fil is None or fil(requester_id, nid, "peers", None))
+        ]
 
     def blocks_by_range_from(
         self, requester_id: str, peer_id: str, start_slot: int, count: int
     ):
         from .sync import SyncPeerError
 
+        fil = self.link_filter
+        if fil is not None and not fil(requester_id, peer_id, "rpc", None):
+            raise SyncPeerError(f"link to {peer_id} is down")
         service = self.peers.get(peer_id)
         if service is None:
             raise SyncPeerError(f"unknown peer {peer_id}")
@@ -46,6 +65,9 @@ class LocalNetwork:
     def status_of(self, node_id: str, peer_id: str):
         from .rpc import StatusMessage
 
+        fil = self.link_filter
+        if fil is not None and not fil(node_id, peer_id, "rpc", None):
+            raise OSError(f"link to {peer_id} is down")
         chain = self.peers[peer_id].client.chain
         state = chain.head_state()
         return StatusMessage(

@@ -44,6 +44,15 @@ class NetworkService:
             self.node_id, Topic.BEACON_AGGREGATE_AND_PROOF, signed_aggregate
         )
 
+    def publish_proposer_slashing(self, slashing) -> None:
+        self.network.publish(self.node_id, Topic.PROPOSER_SLASHING, slashing)
+
+    def publish_attester_slashing(self, slashing) -> None:
+        self.network.publish(self.node_id, Topic.ATTESTER_SLASHING, slashing)
+
+    def publish_voluntary_exit(self, signed_exit) -> None:
+        self.network.publish(self.node_id, Topic.VOLUNTARY_EXIT, signed_exit)
+
     # -- inbound (router/mod.rs on_network_msg) --------------------------------
 
     def on_gossip(self, topic: Topic, message) -> None:
@@ -132,10 +141,14 @@ class NetworkService:
                 if gossip and chain.observed_block_producers.is_observed(
                     int(block.slot), int(block.proposer_index)
                 ):
-                    # a DIFFERENT signature-valid block from this proposer at
-                    # this slot was already imported: gossip equivocation,
-                    # reject without importing (observed_block_producers.rs;
-                    # the slasher sees both via proposer-slashing gossip)
+                    # a DIFFERENT block from this proposer at this slot was
+                    # already imported: gossip equivocation. Reject without
+                    # importing (observed_block_producers.rs), but hand the
+                    # signed header to the slasher — the imported twin was
+                    # fed at import, so this completes the double-proposal
+                    # pair (beacon_chain.rs verify_block_for_gossip ->
+                    # slasher.accept_block_header on both)
+                    self._slasher_accept_header(signed, verify_signature=True)
                     continue
                 try:
                     root = chain.process_block(signed)
@@ -148,6 +161,10 @@ class NetworkService:
                         self._range_sync(signed)
                     # other invalid blocks drop, as gossip verification would
                 else:
+                    if gossip:
+                        # import already proved the proposer signature, so
+                        # the header goes to the slasher unverified
+                        self._slasher_accept_header(signed)
                     # release attestations parked on this root
                     # (work_reprocessing_queue.rs BlockImported)
                     for wt, att in self.reprocess.on_block_imported(root):
@@ -214,6 +231,47 @@ class NetworkService:
         # collect the in-flight attestation verdicts (route callbacks may
         # park items for reprocessing on a later call)
         verifier.flush(route_attestation)
+
+    def _slasher_accept_header(self, signed_block, verify_signature: bool = False) -> None:
+        """Queue a gossip block's header for the slasher's double-proposal
+        detector. `verify_signature` is set on the equivocation path: the
+        duplicate was never imported, so its proposer signature must be
+        proved here — otherwise anyone could forge a second "block" and
+        frame an honest proposer into a slashing."""
+        slasher = getattr(self.client, "slasher", None)
+        if slasher is None:
+            return
+        ctx = self.client.ctx
+        block = signed_block.message
+        if verify_signature:
+            from ..state_transition import signature_sets as sigsets
+
+            state = self.client.chain.head_state()
+            try:
+                sset = sigsets.historical_block_proposal_signature_set(
+                    signed_block,
+                    ctx.bls,
+                    ctx.pubkeys.resolver(state),
+                    ctx.preset,
+                    ctx.spec,
+                    bytes(state.genesis_validators_root),
+                )
+                if not ctx.bls.verify_signature_sets([sset]):
+                    return
+            except (IndexError, KeyError, ValueError):
+                return  # unresolvable proposer: cannot be a valid twin
+        from ..types.containers import BeaconBlockHeader, SignedBeaconBlockHeader
+
+        header = BeaconBlockHeader(
+            slot=block.slot,
+            proposer_index=block.proposer_index,
+            parent_root=block.parent_root,
+            state_root=block.state_root,
+            body_root=type(block.body).hash_tree_root(block.body),
+        )
+        slasher.accept_block_header(
+            SignedBeaconBlockHeader(message=header, signature=signed_block.signature)
+        )
 
     def _range_sync(self, orphan_block) -> None:
         """Unknown-parent trigger: hand the gap to the SyncManager

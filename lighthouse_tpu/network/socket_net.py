@@ -27,7 +27,15 @@ class _RpcNode:
 
 
 class SocketNetwork:
-    def __init__(self, ctx):
+    # fault-injection seam, mirroring LocalNetwork.link_filter: the sim's
+    # LinkFaults installs itself here to drop/delay/duplicate gossip and
+    # sever req/resp links (src/dst are logical node ids)
+    link_filter = None
+
+    def __init__(self, ctx=None):
+        # ctx may be None at construction: it is lazily bound from the
+        # first registered node's client (all nodes on one hub share the
+        # same spec/preset/types context by construction)
         self.ctx = ctx
         self._nodes: dict[str, dict] = {}
         self._lock = threading.Lock()
@@ -37,6 +45,9 @@ class SocketNetwork:
 
     def register(self, node_id: str, service) -> None:
         from .peer_manager import PeerDB
+
+        if self.ctx is None:
+            self.ctx = service.client.ctx
 
         peer_db = PeerDB()  # shared score book: gossip + req/resp
         box: list = []  # late-bound: the deliver closure needs the node
@@ -87,13 +98,28 @@ class SocketNetwork:
         entry["gossip"].publish(topic.full_name(digest, subnet), ssz)
 
     def peer_ids(self, requester_id: str) -> list[str]:
+        fil = self.link_filter
         with self._lock:
-            return [nid for nid in self._nodes if nid != requester_id]
+            ids = [nid for nid in self._nodes if nid != requester_id]
+        if fil is None:
+            return ids
+        return [nid for nid in ids if fil(requester_id, nid, "peers", None)]
 
     def gossip_addr(self, node_id: str):
         """This node's gossip TCP listener (for its ENR tcp field)."""
         with self._lock:
             return self._nodes[node_id]["gossip"].addr
+
+    def rpc_addr(self, node_id: str):
+        """This node's req/resp TCP listener."""
+        with self._lock:
+            return self._nodes[node_id]["rpc"].addr
+
+    def peer_db(self, node_id: str):
+        """This node's peer score book (shared by gossip + req/resp) — the
+        observability hook adversarial scenarios assert against."""
+        with self._lock:
+            return self._nodes[node_id]["peer_db"]
 
     def connect_peer(self, node_id: str, addr, timeout: float = 2.0) -> None:
         """Dial a discovered peer's gossip listener (discovery -> gossip
@@ -113,6 +139,9 @@ class SocketNetwork:
 
         if count <= 0:
             return []
+        fil = self.link_filter
+        if fil is not None and not fil(requester_id, peer_id, "rpc", None):
+            raise SyncPeerError(f"link to {peer_id} is down")
         with self._lock:
             entry = self._nodes.get(peer_id)
         if entry is None:
@@ -131,6 +160,9 @@ class SocketNetwork:
 
     def status_of(self, node_id: str, peer_id: str) -> rpc.StatusMessage:
         """Status handshake from node_id's view of peer_id (rpc status)."""
+        fil = self.link_filter
+        if fil is not None and not fil(node_id, peer_id, "rpc", None):
+            raise OSError(f"link to {peer_id} is down")
         me = self._nodes[node_id]
         peer_addr = self._nodes[peer_id]["rpc"].addr
         chunks = rpc.request(peer_addr, rpc.Protocol.STATUS, me["rpc"].status(), node_id=node_id)
@@ -175,28 +207,50 @@ class SocketNetwork:
             self._digest_cache[gvr] = cached
         return cached
 
-    def _deliver(self, service, gossip, topic_name: str, payload: bytes, src: str) -> None:
+    def _deliver(self, service, gossip, topic_name: str, payload: bytes, src: str):
+        """Gossip delivery callback. Returns False when the payload fails
+        validation (the GossipNode then refuses to forward it — gossipsub
+        v1.1 validate-before-propagate); any other return accepts it."""
+        fil = self.link_filter
+        if fil is None:
+            return self._deliver_app(service, gossip, topic_name, payload, src)
+        # fault layer owns the delivery decision; an un-delivered (dropped
+        # or delayed) message must not be forwarded either, so the verdict
+        # defaults to False unless the filter ran the closure
+        out: list = []
+        fil(
+            src,
+            service.node_id,
+            "gossip",
+            lambda: out.append(
+                self._deliver_app(service, gossip, topic_name, payload, src)
+            ),
+        )
+        return out[0] if out else False
+
+    def _deliver_app(self, service, gossip, topic_name: str, payload: bytes, src: str):
         # /eth2/{digest}/{name}[_{subnet}]/ssz_snappy
         parts = topic_name.strip("/").split("/")
         if len(parts) != 4 or parts[0] != "eth2" or parts[3] != "ssz_snappy":
             gossip.report_invalid_message(src)
-            return
+            return False
         try:
             digest = bytes.fromhex(parts[1])
         except ValueError:
             gossip.report_invalid_message(src)
-            return
+            return False
         parsed = Topic.parse_wire_name(parts[2])
         if parsed is None:
-            return
+            return False  # unknown topic: don't relay what we can't vet
         topic, _subnet = parsed
         if digest not in self._valid_digests(service.client.chain):
-            return  # unknown fork digest: not subscribed (types/topics.rs)
+            # unknown fork digest: not subscribed (types/topics.rs)
+            return False
         try:
             obj = self._decode(topic, payload)
         except Exception:  # noqa: BLE001 — malformed gossip: drop + score
             # the forwarder relayed an undecodable container
             # (gossip_methods.rs reject -> report_peer)
             gossip.report_invalid_message(src)
-            return
-        service.on_gossip(topic, obj)
+            return False
+        return service.on_gossip(topic, obj)

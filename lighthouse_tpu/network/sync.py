@@ -129,6 +129,17 @@ class RangeSync:
             self._next_start = head_slot + 1
         self.target_slot = max(self.target_slot, int(target_slot))
 
+    def start_fork(self, target_slot: int, from_slot: int) -> None:
+        """Re-walk `[from_slot, target_slot]` even though our head is at or
+        above the target: fork recovery. A block whose parent is unknown
+        AFTER a forward fill sits on a branch that diverged below our head,
+        so the walk must restart from the last common point — the finalized
+        checkpoint — to pick the branch up (range sync chains in the
+        reference restart from the finalized epoch for the same reason)."""
+        self.state = SyncState.SYNCING
+        self.target_slot = int(target_slot)
+        self._next_start = max(1, int(from_slot))
+
     def tick(self) -> None:
         """Advance the machine: download + import batches until the target
         is reached, a batch exhausts its attempts, or peers run out."""
@@ -267,7 +278,23 @@ class SyncManager:
         """A gossip block whose parent is unknown: sync the gap then retry
         the orphan (manager.rs UnknownParentBlock)."""
         chain = self.service.client.chain
-        self.range.start(int(orphan_block.message.slot))
+        slot = int(orphan_block.message.slot)
+        self.range.start(slot)
+        self.range.tick()
+        try:
+            chain.process_block(orphan_block)
+            return
+        except Exception:  # noqa: BLE001 — still orphaned: try fork recovery
+            pass
+        # the forward fill didn't connect, so the orphan is on a branch
+        # that diverged BELOW our head (e.g. the other side of a healed
+        # partition): re-walk from the last finalized slot so the branch
+        # imports as a fork and fork choice can weigh it
+        state = chain.head_state()
+        fin_slot = (
+            int(state.finalized_checkpoint.epoch) * chain.ctx.preset.slots_per_epoch
+        )
+        self.range.start_fork(slot, fin_slot + 1)
         self.range.tick()
         try:
             chain.process_block(orphan_block)

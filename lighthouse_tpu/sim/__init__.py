@@ -1,0 +1,52 @@
+"""Adversarial multi-node simulation harness.
+
+In-process beacon-chain testnets — N full nodes (Client + NetworkService +
+ValidatorClient) over a shared hub (synchronous LocalNetwork or real-TCP
+SocketNetwork) — driven slot-by-slot by a deterministic seeded scheduler,
+with fault injection (drop/delay/duplicate/partition links) and scripted
+adversaries (equivocating proposers, gossip flooders, frame bombers).
+
+Quickstart: `python scripts/sim.py --scenario partition_heal --seed 7`,
+or from code:
+
+    from lighthouse_tpu.sim import run_scenario
+    sim = run_scenario("partition_heal", seed=7)
+    print(sim.event_log_json())
+"""
+
+from .adversary import (
+    AdversarialPeer,
+    equivocate_propose,
+    junk_gossip_frame,
+    malformed_data_frame,
+    nesting_bomb,
+    proposer_node_for_slot,
+)
+from .faults import LinkFaults
+from .node import SimNode, build_nodes, build_sim, drain_slashers, run_duty, run_slot
+from .scenario import Scenario, ScenarioAssertion, SimConfig, Simulation
+from .scenarios import SCENARIOS, get_scenario, register, run_scenario
+
+__all__ = [
+    "AdversarialPeer",
+    "LinkFaults",
+    "SCENARIOS",
+    "Scenario",
+    "ScenarioAssertion",
+    "SimConfig",
+    "SimNode",
+    "Simulation",
+    "build_nodes",
+    "build_sim",
+    "drain_slashers",
+    "equivocate_propose",
+    "get_scenario",
+    "junk_gossip_frame",
+    "malformed_data_frame",
+    "nesting_bomb",
+    "proposer_node_for_slot",
+    "register",
+    "run_duty",
+    "run_scenario",
+    "run_slot",
+]

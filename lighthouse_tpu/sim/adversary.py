@@ -1,0 +1,226 @@
+"""Adversarial actors for the simulation harness.
+
+Two attack surfaces, mirroring "Security Review of Ethereum Beacon
+Clients" (arXiv:2109.11677):
+
+- `AdversarialPeer`: a wire-level attacker that speaks just enough of the
+  gossip framing to join the mesh over a real TCP socket, then floods
+  malformed frames, JSON nesting bombs, and junk-SSZ gossip. It never runs
+  a beacon node — everything it sends is handcrafted bytes.
+
+- `equivocate_propose`: a *consensus-level* adversary. A SimNode that owns
+  the slot's proposer key signs TWO conflicting blocks for the same slot,
+  bypassing its own EIP-3076 slashing-protection DB (which exists to stop
+  exactly this), and publishes both. Honest slashers must catch it.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+
+from ..network import rpc
+from ..network.gossip import FRAME_CONTROL, encode_control, encode_message
+from ..network.snappy import _uvarint_encode
+from ..state_transition.helpers import get_beacon_proposer_index
+from ..types import compute_epoch_at_slot
+from ..validator_client import ValidatorStore
+
+# -- handcrafted hostile frames (unit-testable pure builders) ------------------
+
+
+def malformed_data_frame(topic: str = "/eth2/00000000/beacon_block/ssz_snappy") -> bytes:
+    """A data frame whose payload is NOT valid snappy: decode_message
+    raises, charging PENALTY_PROTOCOL_VIOLATION to the sender."""
+    t = topic.encode()
+    return bytes([0]) + _uvarint_encode(len(t)) + t + b"\xff\xfe\xfd\xfc not snappy"
+
+
+def nesting_bomb(depth: int = 5000) -> bytes:
+    """A control frame of validly-nested JSON deep enough to overflow the
+    parser's recursion — must surface as ONE protocol violation, not a
+    receiver-thread crash (gossip.py _on_control catches RecursionError)."""
+    return bytes([FRAME_CONTROL]) + (
+        b'{"x": ' + b"[" * depth + b"]" * depth + b"}"
+    )
+
+
+def junk_gossip_frame(topic: str, seed: int) -> bytes:
+    """Well-formed gossip framing carrying garbage SSZ: passes the gossip
+    layer (novel message id, valid snappy) and fails application decode,
+    charging PENALTY_INVALID_MESSAGE to the immediate sender. `seed` varies
+    the payload so every frame has a fresh message id."""
+    payload = b"\x5a" + seed.to_bytes(8, "little") + b"\x00" * 23
+    return encode_message(topic, payload)
+
+
+class AdversarialPeer:
+    """A hostile peer: raw TCP links into honest gossip listeners.
+
+    Sends a HELLO announcing its logical id (so penalties land on one
+    identity the honest PeerDBs can graylist/ban) and then whatever bytes a
+    scenario asks for. Reader threads drain inbound frames so honest
+    heartbeat traffic cannot block, and notice when an honest node drops
+    the link (the visible effect of being banned)."""
+
+    def __init__(self, node_id: str = "attacker"):
+        self.node_id = node_id
+        self._lock = threading.Lock()
+        self._links: list[socket.socket] = []
+        self.frames_sent = 0
+        self.send_errors = 0
+
+    def connect(self, addr) -> None:
+        sock = socket.create_connection(tuple(addr), timeout=5.0)
+        sock.settimeout(None)
+        with self._lock:
+            self._links.append(sock)
+        threading.Thread(target=self._drain, args=(sock,), daemon=True).start()
+        self._send(sock, encode_control({"hello": self.node_id}))
+
+    def _drain(self, sock: socket.socket) -> None:
+        while True:
+            try:
+                hdr = b""
+                while len(hdr) < 4:
+                    chunk = sock.recv(4 - len(hdr))
+                    if not chunk:
+                        raise OSError("peer closed")
+                    hdr += chunk
+                (n,) = struct.unpack("<I", hdr)
+                while n > 0:
+                    chunk = sock.recv(min(n, 65536))
+                    if not chunk:
+                        raise OSError("peer closed")
+                    n -= len(chunk)
+            except (OSError, struct.error):
+                with self._lock:
+                    if sock in self._links:
+                        self._links.remove(sock)
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return
+
+    def _send(self, sock: socket.socket, frame: bytes) -> None:
+        try:
+            sock.sendall(struct.pack("<I", len(frame)) + frame)
+            self.frames_sent += 1
+        except OSError:
+            self.send_errors += 1
+
+    def broadcast(self, frame: bytes) -> None:
+        with self._lock:
+            links = list(self._links)
+        for sock in links:
+            self._send(sock, frame)
+
+    def live_links(self) -> int:
+        with self._lock:
+            return len(self._links)
+
+    # -- attacks ---------------------------------------------------------------
+
+    def flood_malformed(self, count: int) -> None:
+        for _ in range(count):
+            self.broadcast(malformed_data_frame())
+
+    def flood_nesting_bombs(self, count: int, depth: int = 5000) -> None:
+        for _ in range(count):
+            self.broadcast(nesting_bomb(depth))
+
+    def flood_junk_gossip(self, topic: str, count: int, seed0: int = 0) -> None:
+        for i in range(count):
+            self.broadcast(junk_gossip_frame(topic, seed0 + i))
+
+    def spam_status_rpc(self, addr, count: int) -> int:
+        """Hammer a node's req/resp Status endpoint past its token-bucket
+        quota; returns how many requests got ANY answer (over-quota calls
+        are penalized and refused). Every request carries this attacker's
+        logical id, so the penalties accumulate on one PeerDB record."""
+        req = rpc.StatusMessage(
+            fork_digest=b"\x00" * 4,
+            finalized_root=b"\x00" * 32,
+            finalized_epoch=0,
+            head_root=b"\x00" * 32,
+            head_slot=0,
+        )
+        answered = 0
+        for _ in range(count):
+            try:
+                rpc.request(tuple(addr), rpc.Protocol.STATUS, req, node_id=self.node_id)
+                answered += 1
+            except (OSError, RuntimeError, ValueError, json.JSONDecodeError):
+                continue
+        return answered
+
+    def close(self) -> None:
+        with self._lock:
+            links, self._links = self._links, []
+        for sock in links:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+# -- consensus-level adversary: equivocating proposer --------------------------
+
+
+def proposer_node_for_slot(nodes, slot: int) -> tuple[int, int]:
+    """(node_index, proposer_index) for `slot` under the interleaved key
+    split — which SimNode holds the key that proposes at `slot`."""
+    epoch = compute_epoch_at_slot(slot, nodes[0].client.ctx.preset)
+    duties = nodes[0].api.proposer_duties(epoch)
+    proposer = duties.get(slot)
+    if proposer is None:
+        raise ValueError(f"no proposer duty known for slot {slot}")
+    return int(proposer) % len(nodes), int(proposer)
+
+
+def equivocate_propose(node, slot: int) -> dict:
+    """Sign and publish TWO conflicting blocks for `slot` from `node`'s
+    proposer key, bypassing the validator client (whose slashing-protection
+    DB would refuse the second signature). The first block is imported
+    locally (the adversary follows its own chain A); both go out over
+    gossip. Returns {"proposer", "root_a", "root_b"} for assertions."""
+    client = node.client
+    chain = client.chain
+    ctx = client.ctx
+
+    probe = chain.state_at_slot(slot)
+    proposer = get_beacon_proposer_index(probe, ctx.preset, ctx.spec)
+    sk, _ = ctx.bls.interop_keypair(proposer)
+    pk = bytes(probe.validators[proposer].pubkey)
+
+    # randao has no slashing protection: a throwaway store signs it
+    signer = ValidatorStore(ctx)
+    signer.add_validator(sk)
+    epoch = compute_epoch_at_slot(slot, ctx.preset)
+    reveal = signer.sign_randao(pk, epoch, chain.head_state())
+
+    signed = {}
+    for tag in ("A", "B"):
+        state = chain.state_at_slot(slot)
+        atts = client.op_pool.get_attestations(state)
+        block, _ = chain.produce_block_on_state(
+            state,
+            slot,
+            reveal,
+            attestations=atts,
+            graffiti=(b"equivocation/" + tag.encode()).ljust(32, b"\x00"),
+        )
+        signed[tag] = chain.sign_block(block, sk)
+
+    root_a = chain.process_block(signed["A"])
+    node.service.publish_block(signed["A"])
+    node.service.publish_block(signed["B"])
+    msg_b = signed["B"].message
+    return {
+        "proposer": proposer,
+        "root_a": root_a,
+        "root_b": type(msg_b).hash_tree_root(msg_b),
+    }

@@ -1,0 +1,127 @@
+"""Fault injection: per-link drop/delay/duplicate rules for the sim.
+
+Installs as the `link_filter` seam both network hubs expose: every gossip
+delivery and req/resp call consults the filter with (src, dst) — the
+simulator's stand-in for the packet-level impairments the reference
+exercises with real network namespaces. Rules are directional; a
+partition is drop rules both ways across the cut.
+
+Thread-safety: socket-mode delivery happens on receiver threads, so every
+rule/queue mutation holds `_lock`; the `deliver` callbacks run OUTSIDE it
+(delivery re-enters node locks and must not nest under ours).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+
+class LinkFaults:
+    """Directional link rules: drop (probability), delay (slots), duplicate.
+
+    Gossip calls arrive as `filter(src, dst, "gossip", deliver)` and the
+    filter owns the delivery decision: call `deliver()` zero times (drop),
+    once (pass), twice (duplicate) or stash it for a later slot (delay).
+    Req/resp calls arrive as `filter(src, dst, "rpc", None) -> bool`; a
+    fully-dropped link severs RPC too (a partitioned node must not range-
+    sync across the cut it cannot gossip across)."""
+
+    def __init__(self, rng: random.Random | None = None):
+        self._lock = threading.Lock()
+        self._rng = rng or random.Random(0)
+        # (src, dst) -> {"drop": float, "delay": int, "duplicate": bool}
+        self._rules: dict[tuple[str, str], dict] = {}
+        self._delayed: list[tuple[int, int, object]] = []  # (release_slot, seq, deliver)
+        self._seq = 0
+        self._slot = 0
+        self.dropped = 0
+        self.duplicated = 0
+        self.delayed = 0
+
+    # -- rule management -------------------------------------------------------
+
+    def set_link(
+        self, src: str, dst: str, *, drop: float = 0.0, delay: int = 0, duplicate: bool = False
+    ) -> None:
+        with self._lock:
+            self._rules[(src, dst)] = {
+                "drop": float(drop),
+                "delay": int(delay),
+                "duplicate": bool(duplicate),
+            }
+
+    def clear_link(self, src: str, dst: str) -> None:
+        with self._lock:
+            self._rules.pop((src, dst), None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+
+    def partition(self, group_a, group_b) -> None:
+        """Sever every link across the cut, both directions, gossip + RPC."""
+        for a in group_a:
+            for b in group_b:
+                self.set_link(a, b, drop=1.0)
+                self.set_link(b, a, drop=1.0)
+
+    def links(self) -> dict:
+        with self._lock:
+            return {k: dict(v) for k, v in self._rules.items()}
+
+    # -- the network-facing filter ---------------------------------------------
+
+    def __call__(self, src: str, dst: str, kind: str, deliver=None):
+        with self._lock:
+            rule = self._rules.get((src, dst))
+            if rule is None:
+                decision = "pass"
+            elif kind != "gossip":
+                # RPC/status/peer-listing: severed only by a hard drop —
+                # probabilistic loss and reordering are gossip phenomena
+                return rule["drop"] < 1.0
+            elif rule["drop"] >= 1.0 or (
+                rule["drop"] > 0.0 and self._rng.random() < rule["drop"]
+            ):
+                self.dropped += 1
+                decision = "drop"
+            elif rule["delay"] > 0:
+                self._seq += 1
+                self._delayed.append((self._slot + rule["delay"], self._seq, deliver))
+                self.delayed += 1
+                decision = "delay"
+            elif rule["duplicate"]:
+                self.duplicated += 1
+                decision = "duplicate"
+            else:
+                decision = "pass"
+        if kind != "gossip":
+            return True
+        if decision == "pass":
+            deliver()
+        elif decision == "duplicate":
+            deliver()
+            deliver()
+        return None
+
+    # -- slot clock ------------------------------------------------------------
+
+    def on_slot(self, slot: int) -> int:
+        """Advance the fault clock and release every delayed delivery whose
+        slot has arrived, in deterministic (release_slot, seq) order.
+        Returns the number released."""
+        with self._lock:
+            self._slot = int(slot)
+            due = sorted(
+                [d for d in self._delayed if d[0] <= self._slot],
+                key=lambda d: (d[0], d[1]),
+            )
+            self._delayed = [d for d in self._delayed if d[0] > self._slot]
+        for _, _, deliver in due:
+            deliver()
+        return len(due)
+
+    def install(self, *networks) -> None:
+        for net in networks:
+            net.link_filter = self

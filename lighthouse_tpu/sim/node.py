@@ -1,0 +1,192 @@
+"""Node orchestration for the in-process simulator.
+
+The single implementation behind both the happy-path liveness tests
+(tests/test_simulator.py) and the adversarial scenario suite
+(sim/scenarios.py): build N full nodes — Client + NetworkService +
+ValidatorClient — over a shared network hub (LocalNetwork or
+SocketNetwork), and drive them slot by slot the way the reference's
+testing/simulator drives its local testnet (checks.rs epoch loop).
+"""
+
+from __future__ import annotations
+
+from ..chain.beacon_chain import BlockError
+from ..client import Client, ClientConfig
+from ..network import NetworkService
+from ..state_transition import StateTransitionError
+from ..types import compute_epoch_at_slot
+from ..validator_client import BeaconNodeApi, ValidatorClient, ValidatorStore
+
+
+class SimNode:
+    """One simulated node: beacon client, its network service, and the
+    validator client holding this node's share of the keys.
+
+    Iterable as the (client, service, vc) triple so pre-existing callers
+    that unpack tuples keep working."""
+
+    def __init__(self, index: int, client, service, vc):
+        self.index = index
+        self.client = client
+        self.service = service
+        self.vc = vc
+
+    @property
+    def chain(self):
+        return self.client.chain
+
+    @property
+    def api(self):
+        return self.vc.api
+
+    @property
+    def node_id(self) -> str:
+        return self.service.node_id
+
+    def __iter__(self):
+        return iter((self.client, self.service, self.vc))
+
+    def __getitem__(self, i):
+        return (self.client, self.service, self.vc)[i]
+
+    def __repr__(self) -> str:
+        return f"SimNode({self.node_id})"
+
+
+def build_nodes(
+    net,
+    n_nodes: int,
+    n_validators: int,
+    *,
+    bls_backend: str = "fake",
+    slasher: bool = False,
+    spec_override=None,
+    config_overrides: dict[int, dict] | None = None,
+) -> list[SimNode]:
+    """Spin `n_nodes` full nodes on `net` with `n_validators` interop keys
+    split across them (interleaved: validator i lives on node i % n_nodes).
+
+    `config_overrides` maps node index -> extra ClientConfig kwargs (e.g.
+    {0: {"http_enabled": True}} to give node 0 a checkpoint-serving API)."""
+    nodes = []
+    for n in range(n_nodes):
+        kwargs = dict(
+            bls_backend=bls_backend,
+            http_enabled=False,
+            interop_validators=n_validators,
+            slasher_enabled=slasher,
+            spec_override=spec_override,
+        )
+        if config_overrides and n in config_overrides:
+            kwargs.update(config_overrides[n])
+        client = Client(ClientConfig(**kwargs))
+        service = NetworkService(f"node{n}", client, net)
+        api = BeaconNodeApi(client.chain, op_pool=client.op_pool)
+        store = ValidatorStore(client.ctx)
+        for i in range(n, n_validators, n_nodes):  # interleaved split
+            sk, _ = client.ctx.bls.interop_keypair(i)
+            store.add_validator(sk)
+        vc = ValidatorClient(api, store)
+        nodes.append(SimNode(n, client, service, vc))
+    return nodes
+
+
+def build_sim(n_nodes: int = 3, n_validators: int = 12):
+    """The historical tests/test_simulator.py entry point: a LocalNetwork
+    with `n_nodes` fake-BLS nodes. Returns (net, nodes)."""
+    from ..network import LocalNetwork
+
+    net = LocalNetwork()
+    return net, build_nodes(net, n_nodes, n_validators)
+
+
+def run_duty(node, slot: int) -> dict:
+    """One node's validator duties for `slot`, with produced blocks and
+    attestations also published over gossip (the BN publish path). Returns
+    the VC's duty summary."""
+    client, service, vc = node
+    orig_pub_block = vc.api.publish_block
+    orig_pub_att = vc.api.publish_attestation
+
+    def pub_block(signed, _orig=orig_pub_block, _svc=service):
+        root = _orig(signed)
+        _svc.publish_block(signed)
+        return root
+
+    def pub_att(att, _orig=orig_pub_att, _svc=service):
+        ok = _orig(att)
+        if ok:
+            _svc.publish_attestation(att)
+        return ok
+
+    vc.api.publish_block = pub_block
+    vc.api.publish_attestation = pub_att
+    try:
+        return vc.on_slot(slot)
+    except (BlockError, StateTransitionError) as e:
+        # e.g. the proposer was slashed mid-run: production/import refuses
+        # its block; a real BN answers the VC with an error, the VC logs
+        # and moves on — the slot goes empty, the sim must not crash
+        return {"proposed": None, "attested": 0, "error": str(e)}
+    finally:
+        vc.api.publish_block = orig_pub_block
+        vc.api.publish_attestation = orig_pub_att
+
+
+def drain_slashers(nodes, slot: int) -> list:
+    """Run every node's slasher over its queued material and gossip any
+    slashings it produced (the Client.per_slot_task slasher step, plus the
+    broadcast the reference does via the proposer/attester-slashing topics).
+    Returns [(node_index, kind, slashing), ...] for scenario assertions."""
+    found = []
+    for i, (client, service, _) in enumerate(nodes):
+        if client.slasher is None:
+            continue
+        epoch = compute_epoch_at_slot(slot, client.ctx.preset)
+        atts, props = client.slasher.process_queued(epoch)
+        for s in atts:
+            client.op_pool.insert_attester_slashing(s)
+            service.publish_attester_slashing(s)
+            found.append((i, "attester", s))
+        for s in props:
+            client.op_pool.insert_proposer_slashing(s)
+            service.publish_proposer_slashing(s)
+            found.append((i, "proposer", s))
+    return found
+
+
+def run_slot(nodes, slot: int, *, duty_overrides=None, settle=None) -> list:
+    """Advance every node through one slot:
+
+      1. tick clocks/fork choice and ingest the previous slot's gossip
+      2. run validator duties (or a scenario's override) per node, publishing
+      3. ingest this slot's gossip everywhere, then drain slashers
+
+    `duty_overrides` maps node index -> callable(node, slot) replacing that
+    node's VC duties for this slot (how an adversarial proposer equivocates
+    without fighting its own slashing-protection DB). `settle` is an
+    optional barrier called between phases — socket-mode runs pass one to
+    wait for in-flight frames; the LocalNetwork is synchronous and needs
+    none. Returns the per-node duty summaries."""
+    duty_overrides = duty_overrides or {}
+    for client, service, _ in nodes:
+        client.chain.slot_clock.set_slot(slot)
+        client.chain.fork_choice.on_tick(slot)
+        service.process_pending()
+    if settle is not None:
+        settle()
+    summaries = []
+    for i, node in enumerate(nodes):
+        override = duty_overrides.get(i)
+        if override is not None:
+            summaries.append(override(node, slot))
+        else:
+            summaries.append(run_duty(node, slot))
+    if settle is not None:
+        settle()
+    for client, service, _ in nodes:
+        service.process_pending()
+    drain_slashers(nodes, slot)
+    if settle is not None:
+        settle()
+    return summaries

@@ -1,0 +1,237 @@
+"""Scenario API + the Simulation driver.
+
+A Scenario scripts an adversarial storyline over a Simulation: `setup`
+prepares the world, `step(sim, slot)` runs just before each slot (inject
+faults, schedule attacks, override duties), `check(sim)` makes the final
+assertions. The Simulation owns N SimNodes on one network hub, a seeded
+RNG, a deterministic slot-indexed event scheduler, the fault layer, and an
+append-only event log — the log is the determinism contract: two runs with
+the same seed must produce byte-identical logs (`--replay` and the
+determinism-guard test compare them).
+
+Socket mode notes: real sockets mean real threads, so the per-slot driver
+inserts quiescence barriers (`_settle`) between phases, and the event log
+records only convergent facts (head slots, finality epochs, booleans) —
+never raw roots, scores, or timings that an arrival race could perturb.
+Local mode is fully synchronous and logs head roots verbatim.
+"""
+
+from __future__ import annotations
+
+import heapq
+import json
+import random
+from dataclasses import dataclass, field
+
+from .faults import LinkFaults
+from .node import build_nodes, run_slot
+
+
+class ScenarioAssertion(AssertionError):
+    """A scenario's assert_ failed; the event log holds the context."""
+
+
+@dataclass
+class SimConfig:
+    n_nodes: int = 3
+    n_validators: int = 12
+    net: str = "local"  # "local" | "socket"
+    seed: int = 0
+    slasher: bool = False
+    bls_backend: str = "fake"
+    spec_override: object = None
+    config_overrides: dict = field(default_factory=dict)
+
+
+class Scenario:
+    """Base scenario: subclass, set `name`/`description`/`slots`, implement
+    the hooks. Register concrete scenarios in sim.scenarios.SCENARIOS."""
+
+    name = ""
+    description = ""
+    slots = 32
+    snapshot_each_slot = True
+
+    def config(self, seed: int) -> SimConfig:
+        return SimConfig(seed=seed)
+
+    def setup(self, sim: "Simulation") -> None:
+        pass
+
+    def step(self, sim: "Simulation", slot: int) -> None:
+        """Called before `slot` runs — schedule faults/attacks here."""
+
+    def check(self, sim: "Simulation") -> None:
+        pass
+
+
+class Simulation:
+    def __init__(self, cfg: SimConfig):
+        self.cfg = cfg
+        self.rng = random.Random(cfg.seed)
+        self.slot = 0
+        self.events: list[dict] = []
+        self._scheduled: list = []  # heap of (slot, seq, label, fn)
+        self._seq = 0
+        self._duty_overrides: dict[int, dict] = {}  # slot -> {node_idx: fn}
+        if cfg.net == "socket":
+            from ..network.socket_net import SocketNetwork
+
+            self.net = SocketNetwork()
+        elif cfg.net == "local":
+            from ..network import LocalNetwork
+
+            self.net = LocalNetwork()
+        else:
+            raise ValueError(f"unknown net mode {cfg.net!r} (local|socket)")
+        self.nodes = build_nodes(
+            self.net,
+            cfg.n_nodes,
+            cfg.n_validators,
+            bls_backend=cfg.bls_backend,
+            slasher=cfg.slasher,
+            spec_override=cfg.spec_override,
+            config_overrides=cfg.config_overrides,
+        )
+        # independent stream so scenario-level rng draws don't shift fault
+        # decisions (and vice versa) — both derive from the one seed
+        self.faults = LinkFaults(rng=random.Random(cfg.seed ^ 0x5EED))
+        self.faults.install(self.net)
+        self.log(
+            "sim_start",
+            nodes=cfg.n_nodes,
+            validators=cfg.n_validators,
+            net=cfg.net,
+            seed=cfg.seed,
+            slasher=cfg.slasher,
+        )
+
+    # -- event log (the determinism contract) ----------------------------------
+
+    def log(self, kind: str, **fields) -> None:
+        self.events.append({"slot": self.slot, "kind": kind, **fields})
+
+    def event_log_json(self) -> str:
+        return json.dumps(self.events, sort_keys=True, default=str)
+
+    def assert_(self, cond, check: str, **fields) -> None:
+        """Logged assertion: the verdict lands in the event log either way;
+        a failure raises ScenarioAssertion."""
+        self.log("assert", check=check, ok=bool(cond), **fields)
+        if not cond:
+            raise ScenarioAssertion(f"{check}: {fields}")
+
+    # -- scheduler -------------------------------------------------------------
+
+    def at(self, slot: int, fn, label: str = "") -> None:
+        """Run `fn(sim)` at the START of `slot`, before duties. Events fire
+        in (slot, insertion-order) — deterministic by construction."""
+        self._seq += 1
+        heapq.heappush(self._scheduled, (int(slot), self._seq, label, fn))
+
+    def override_duty(self, slot: int, node_index: int, fn) -> None:
+        """Replace node_index's validator duties at `slot` with
+        `fn(node, slot)` (e.g. an equivocating double-proposal)."""
+        self._duty_overrides.setdefault(int(slot), {})[node_index] = fn
+
+    # -- driving ---------------------------------------------------------------
+
+    def step(self) -> None:
+        self.slot += 1
+        released = self.faults.on_slot(self.slot)
+        if released:
+            self.log("delayed_released", count=released)
+        while self._scheduled and self._scheduled[0][0] <= self.slot:
+            _, _, label, fn = heapq.heappop(self._scheduled)
+            self.log("event", label=label)
+            fn(self)
+        overrides = self._duty_overrides.pop(self.slot, None)
+        settle = self._settle if self.cfg.net == "socket" else None
+        summaries = run_slot(
+            self.nodes, self.slot, duty_overrides=overrides, settle=settle
+        )
+        if self.cfg.net == "local":
+            # proposals are deterministic facts; attested counts over
+            # sockets race the barrier, so only local mode logs duties
+            self.log(
+                "duties",
+                proposed=[
+                    "0x" + s["proposed"].hex() if s and s.get("proposed") else None
+                    for s in summaries
+                ],
+            )
+
+    def run_slots(self, n: int) -> None:
+        for _ in range(n):
+            self.step()
+
+    def snapshot(self) -> dict:
+        """Convergent per-node chain facts, shaped for the event log:
+        roots only in local mode (see module docstring)."""
+        heads, slots, fin, just = [], [], [], []
+        for node in self.nodes:
+            state = node.chain.head_state()
+            heads.append("0x" + node.chain.head_root.hex()[:16])
+            slots.append(int(state.slot))
+            fin.append(int(state.finalized_checkpoint.epoch))
+            just.append(int(state.current_justified_checkpoint.epoch))
+        snap = {"head_slots": slots, "finalized": fin, "justified": just}
+        if self.cfg.net == "local":
+            snap["heads"] = heads
+        return snap
+
+    def log_snapshot(self) -> dict:
+        snap = self.snapshot()
+        self.log("state", **snap)
+        return snap
+
+    def _settle(self, deadline: float = 15.0, quiet_rounds: int = 2) -> None:
+        """Socket-mode barrier: drain every node until no new work arrives
+        for `quiet_rounds` consecutive polls (submitted counters stable AND
+        all queues empty)."""
+        import time
+
+        end = time.monotonic() + deadline
+        quiet, last = 0, -1
+        while time.monotonic() < end:
+            for _, service, _ in self.nodes:
+                service.process_pending()
+            submitted = sum(
+                sum(node.client.processor.stats.submitted.values())
+                for node in self.nodes
+            )
+            pending = sum(len(node.client.processor) for node in self.nodes)
+            if submitted == last and pending == 0:
+                quiet += 1
+                if quiet >= quiet_rounds:
+                    return
+            else:
+                quiet = 0
+                last = submitted
+            time.sleep(0.05)
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def run(self, scenario: Scenario) -> "Simulation":
+        try:
+            scenario.setup(self)
+            while self.slot < scenario.slots:
+                scenario.step(self, self.slot + 1)
+                self.step()
+                if scenario.snapshot_each_slot:
+                    self.log_snapshot()
+            scenario.check(self)
+            self.log("scenario_ok", name=scenario.name)
+        finally:
+            self.close()
+        return self
+
+    def close(self) -> None:
+        for node in self.nodes:
+            try:
+                node.client.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+        close = getattr(self.net, "close", None)
+        if close is not None:
+            close()

@@ -670,10 +670,17 @@ class ValidatorClient:
             if pk in self.store.keys:
                 reveal = self.store.sign_randao(pk, epoch, state)
                 block = self.api.produce_block(slot, reveal)
-                sig = self.store.sign_block(pk, block, state)
-                signed_cls = ctx.types.for_fork(ctx.types.fork_of(block.body)).SignedBeaconBlock
-                signed = signed_cls(message=block, signature=sig)
-                summary["proposed"] = self.api.publish_block(signed)
+                try:
+                    sig = self.store.sign_block(pk, block, state)
+                except SlashingProtectionError:
+                    # a proposal was already signed for this slot (e.g. the
+                    # key is doubled elsewhere): refuse, keep attesting —
+                    # the DB refusing is the success case, not a crash
+                    sig = None
+                if sig is not None:
+                    signed_cls = ctx.types.for_fork(ctx.types.fork_of(block.body)).SignedBeaconBlock
+                    signed = signed_cls(message=block, signature=sig)
+                    summary["proposed"] = self.api.publish_block(signed)
 
         # -- attestation duties at slot (attestation_service.rs:125) --
         head_state = self.api.chain.head_state()

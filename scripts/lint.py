@@ -50,7 +50,7 @@ from lighthouse_tpu.analysis.engine import (  # noqa: E402
 )
 from lighthouse_tpu.analysis.lints import default_checkers  # noqa: E402
 
-DEFAULT_PATHS = ["lighthouse_tpu"]
+DEFAULT_PATHS = ["lighthouse_tpu", "scripts"]
 ALLOWLIST = REPO_ROOT / "scripts" / "lint_allowlist.txt"
 
 

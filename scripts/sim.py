@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Adversarial simulation runner (ROADMAP item 5: scripts/sim.py).
+
+Runs a scripted multi-node scenario from lighthouse_tpu.sim against an
+in-process testnet and prints its event log — the deterministic artifact
+two runs with the same seed must reproduce byte-for-byte.
+
+    python scripts/sim.py --list
+    python scripts/sim.py --scenario partition_heal --seed 7
+    python scripts/sim.py --scenario gossip_flood --replay
+    python scripts/sim.py --scenario equivocation_slashing --json
+
+`--replay` runs the scenario twice with the same seed and fails loudly if
+the event logs differ (the determinism guard, runnable by hand)."""
+
+from __future__ import annotations
+
+import argparse
+import difflib
+import json
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+from lighthouse_tpu.sim import SCENARIOS, ScenarioAssertion, run_scenario  # noqa: E402
+
+
+def _list_scenarios() -> None:
+    width = max(len(name) for name in SCENARIOS)
+    for name in sorted(SCENARIOS):
+        cls = SCENARIOS[name]
+        cfg = cls().config(0)
+        print(
+            f"{name:<{width}}  [{cfg.net}, {cfg.n_nodes} nodes x "
+            f"{cfg.n_validators} validators, {cls.slots} slots]"
+        )
+        print(f"{'':<{width}}  {cls.description}")
+
+
+def _run_once(name: str, seed: int, net: str | None) -> str:
+    sim = run_scenario(name, seed=seed, net=net)
+    return sim.event_log_json()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--list", action="store_true", help="list scenarios and exit")
+    parser.add_argument("--scenario", help="scenario name (see --list)")
+    parser.add_argument("--seed", type=int, default=0, help="deterministic seed")
+    parser.add_argument(
+        "--net",
+        choices=("local", "socket"),
+        default=None,
+        help="override the scenario's network mode",
+    )
+    parser.add_argument(
+        "--replay",
+        action="store_true",
+        help="run twice with the same seed and diff the event logs",
+    )
+    parser.add_argument(
+        "--json", action="store_true", help="print the raw event-log JSON"
+    )
+    args = parser.parse_args(argv)
+
+    if args.list:
+        _list_scenarios()
+        return 0
+    if not args.scenario:
+        parser.error("--scenario is required (or --list)")
+    if args.scenario not in SCENARIOS:
+        parser.error(
+            f"unknown scenario {args.scenario!r}; known: {', '.join(sorted(SCENARIOS))}"
+        )
+
+    try:
+        log = _run_once(args.scenario, args.seed, args.net)
+    except ScenarioAssertion as e:
+        print(f"FAIL {args.scenario} (seed {args.seed}): {e}", file=sys.stderr)
+        return 1
+
+    if args.replay:
+        try:
+            second = _run_once(args.scenario, args.seed, args.net)
+        except ScenarioAssertion as e:
+            print(f"FAIL {args.scenario} replay (seed {args.seed}): {e}", file=sys.stderr)
+            return 1
+        if second != log:
+            print(
+                f"REPLAY DIVERGED for {args.scenario} (seed {args.seed}):",
+                file=sys.stderr,
+            )
+            a = [json.dumps(e) for e in json.loads(log)]
+            b = [json.dumps(e) for e in json.loads(second)]
+            for line in difflib.unified_diff(a, b, "run1", "run2", lineterm="", n=1):
+                print(line, file=sys.stderr)
+            return 1
+
+    if args.json:
+        print(log)
+    else:
+        events = json.loads(log)
+        for event in events:
+            slot, kind = event.pop("slot"), event.pop("kind")
+            detail = ", ".join(f"{k}={v}" for k, v in sorted(event.items()))
+            print(f"slot {slot:>3}  {kind:<18} {detail}")
+        replayed = " (replay identical)" if args.replay else ""
+        print(f"OK {args.scenario} seed={args.seed} events={len(events)}{replayed}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,7 +107,14 @@ def pytest_collection_modifyitems(config, items):
 # while holding a lock fails the test with both acquisition stacks. Off by
 # default — the wrappers add overhead and belong to the nightly/triage tier.
 
-_LOCKCHECK_MODULES = {"test_concurrency", "test_batch_verifier", "test_gossipsub"}
+_LOCKCHECK_MODULES = {
+    "test_concurrency",
+    "test_batch_verifier",
+    "test_gossipsub",
+    # multi-node sim meshes: the richest lock-interleaving workload we have
+    "test_sim",
+    "test_sim_scenarios",
+}
 
 
 @pytest.fixture(autouse=True)

@@ -81,10 +81,20 @@ def test_inputs_actually_sharded(mesh, batch):
     lowered = kernel.lower(*(jnp.asarray(a) for a in staged))
     hlo = lowered.as_text()
     # (a) the shard_map manual computation shards its data inputs over the
-    # `sets` mesh axis: one {"sets"} dim-sharding per staged input. With
-    # in_specs flipped to replicated this count drops to <= 1 (the mesh decl).
-    assert hlo.count('{"sets"}') >= 8, "staged inputs are not sharded over the sets axis"
-    # (b) the per-device (local) input shapes carry S/8 sets, proving an
+    # `sets` mesh axis: one leading-axis 8-way device sharding per staged
+    # input ({devices=[8,...]<=[8]} in the StableHLO sharding syntax; the
+    # named-axis {"sets"} spelling is not emitted by this jax version).
+    # With in_specs flipped to replicated these all become {replicated}.
+    import re
+
+    n_sharded = len(re.findall(r"\{devices=\[8[,\]\d]*<=\[8\]\}", hlo))
+    assert n_sharded >= len(staged), (
+        f"staged inputs are not sharded over the sets axis "
+        f"({n_sharded} 8-way shardings for {len(staged)} inputs)"
+    )
+    # (b) the cross-chip all-gather of the per-device Fp12 Miller partials
+    assert "all_gather" in hlo or "all-gather" in hlo, "no cross-chip all-gather"
+    # (c) the per-device (local) input shapes carry S/8 sets, proving an
     # 8-way split of the batch, e.g. the r_bits operand at (S/8, 64).
     assert f"tensor<{S // 8}x64xi32>" in hlo, "local shard shapes are not S/8"
 

@@ -1,0 +1,180 @@
+"""Simulation-harness units: fault-injection rules, the deterministic
+scheduler, hostile frame builders, the scenario registry, and the
+determinism guard (a scenario replayed with one seed must produce a
+byte-identical event log — the flake insurance for the whole suite)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from lighthouse_tpu.network.gossip import FRAME_CONTROL, decode_message, message_id
+from lighthouse_tpu.sim import (
+    SCENARIOS,
+    LinkFaults,
+    SimConfig,
+    Simulation,
+    junk_gossip_frame,
+    malformed_data_frame,
+    nesting_bomb,
+    run_scenario,
+)
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+
+# -- LinkFaults ----------------------------------------------------------------
+
+
+def test_faults_default_pass_through():
+    faults = LinkFaults()
+    hits = []
+    faults("a", "b", "gossip", lambda: hits.append(1))
+    assert hits == [1]
+    assert faults("a", "b", "rpc", None) is True
+
+
+def test_faults_hard_drop_severs_gossip_and_rpc():
+    faults = LinkFaults()
+    faults.set_link("a", "b", drop=1.0)
+    hits = []
+    faults("a", "b", "gossip", lambda: hits.append(1))
+    assert hits == []
+    assert faults.dropped == 1
+    assert faults("a", "b", "rpc", None) is False
+    # directional: the reverse link is untouched
+    faults("b", "a", "gossip", lambda: hits.append(2))
+    assert hits == [2]
+    assert faults("b", "a", "rpc", None) is True
+
+
+def test_faults_probabilistic_drop_leaves_rpc_up():
+    faults = LinkFaults()
+    faults.set_link("a", "b", drop=0.5)
+    # lossy-but-not-severed links are a gossip phenomenon; RPC stays up
+    assert faults("a", "b", "rpc", None) is True
+
+
+def test_faults_duplicate_delivers_twice():
+    faults = LinkFaults()
+    faults.set_link("a", "b", duplicate=True)
+    hits = []
+    faults("a", "b", "gossip", lambda: hits.append(1))
+    assert hits == [1, 1]
+    assert faults.duplicated == 1
+
+
+def test_faults_delay_releases_in_order():
+    faults = LinkFaults()
+    faults.set_link("a", "b", delay=2)
+    order = []
+    faults("a", "b", "gossip", lambda: order.append("first"))
+    faults("a", "b", "gossip", lambda: order.append("second"))
+    assert order == []
+    assert faults.on_slot(1) == 0
+    assert order == []
+    assert faults.on_slot(2) == 2  # queued at slot 0, due at 0 + 2
+    assert order == ["first", "second"]  # insertion order within a slot
+
+
+def test_faults_partition_and_clear():
+    faults = LinkFaults()
+    faults.partition(["a", "b"], ["c"])
+    links = faults.links()
+    assert links[("a", "c")]["drop"] == 1.0
+    assert links[("c", "a")]["drop"] == 1.0
+    assert links[("b", "c")]["drop"] == 1.0
+    assert ("a", "b") not in links
+    faults.clear()
+    assert faults.links() == {}
+    assert faults("a", "c", "rpc", None) is True
+
+
+# -- hostile frame builders ----------------------------------------------------
+
+
+def test_malformed_frame_fails_decode():
+    with pytest.raises(Exception):
+        decode_message(malformed_data_frame())
+
+
+def test_nesting_bomb_overflows_json_parser():
+    frame = nesting_bomb(depth=50000)
+    assert frame[0] == FRAME_CONTROL
+    with pytest.raises(RecursionError):
+        json.loads(frame[1:])
+
+
+def test_junk_gossip_frames_are_novel_valid_gossip():
+    topic = "/eth2/00000000/beacon_block/ssz_snappy"
+    ids = set()
+    for seed in range(8):
+        got_topic, payload = decode_message(junk_gossip_frame(topic, seed))
+        assert got_topic == topic
+        ids.add(message_id(payload))
+    assert len(ids) == 8  # every frame has a fresh message id
+
+
+# -- scenario registry + CLI ---------------------------------------------------
+
+
+def test_registry_has_the_issue_scenarios():
+    assert len(SCENARIOS) >= 5
+    assert {
+        "partition_heal",
+        "equivocation_slashing",
+        "gossip_flood",
+        "validator_churn",
+        "cold_backfill",
+    } <= set(SCENARIOS)
+    for name, cls in SCENARIOS.items():
+        assert cls.name == name
+        assert cls.description
+        cfg = cls().config(seed=3)
+        assert isinstance(cfg, SimConfig)
+        assert cfg.seed == 3
+        assert cfg.net in ("local", "socket")
+
+
+def test_cli_list_shows_every_scenario():
+    out = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "scripts" / "sim.py"), "--list"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        check=True,
+    ).stdout
+    for name in SCENARIOS:
+        assert name in out
+
+
+# -- scheduler + event log -----------------------------------------------------
+
+
+def test_scheduler_fires_in_slot_then_insertion_order():
+    sim = Simulation(SimConfig(n_nodes=2, n_validators=4, net="local", seed=1))
+    try:
+        fired = []
+        sim.at(2, lambda s: fired.append("late"), label="late")
+        sim.at(1, lambda s: fired.append("early-a"), label="early-a")
+        sim.at(1, lambda s: fired.append("early-b"), label="early-b")
+        sim.step()
+        assert fired == ["early-a", "early-b"]
+        sim.step()
+        assert fired == ["early-a", "early-b", "late"]
+        labels = [e["label"] for e in sim.events if e["kind"] == "event"]
+        assert labels == ["early-a", "early-b", "late"]
+    finally:
+        sim.close()
+
+
+# -- determinism guard (satellite: --seed/--replay flake insurance) ------------
+
+
+@pytest.mark.slow
+def test_partition_heal_replay_is_bit_identical():
+    first = run_scenario("partition_heal", seed=7).event_log_json()
+    second = run_scenario("partition_heal", seed=7).event_log_json()
+    assert first == second

@@ -1,0 +1,57 @@
+"""Slow-tier wrappers for the adversarial scenario suite (scripts/sim.py).
+
+One test per registered scenario, each running the full storyline with a
+fixed seed and requiring the scenario's own assertions (monitor / metrics /
+fork-choice state) to land — the `scenario_ok` event is only logged after
+`check()` passed. Runs under --runslow / LIGHTHOUSE_TPU_SLOW=1, and under
+LIGHTHOUSE_TPU_LOCKCHECK=1 these meshes are the richest lock-interleaving
+workload in the repo (see tests/conftest.py)."""
+
+import pytest
+
+from lighthouse_tpu.sim import SCENARIOS, run_scenario
+
+SEED = 7
+
+
+def _run(name: str) -> None:
+    sim = run_scenario(name, seed=SEED)
+    assert sim.events[-1]["kind"] == "scenario_ok", sim.events[-1]
+    failed = [e for e in sim.events if e["kind"] == "assert" and not e["ok"]]
+    assert not failed, failed
+
+
+@pytest.mark.slow
+def test_scenario_partition_heal():
+    _run("partition_heal")
+
+
+@pytest.mark.slow
+def test_scenario_equivocation_slashing():
+    _run("equivocation_slashing")
+
+
+@pytest.mark.slow
+def test_scenario_gossip_flood():
+    _run("gossip_flood")
+
+
+@pytest.mark.slow
+def test_scenario_validator_churn():
+    _run("validator_churn")
+
+
+@pytest.mark.slow
+def test_scenario_cold_backfill():
+    _run("cold_backfill")
+
+
+def test_every_registered_scenario_has_a_wrapper():
+    """A new scenario must get its own slow wrapper above — this guard
+    fails collection-time (cheap, tier-1) when one is forgotten."""
+    wrapped = {
+        name[len("test_scenario_") :]
+        for name in globals()
+        if name.startswith("test_scenario_")
+    }
+    assert wrapped == set(SCENARIOS)

@@ -1,78 +1,21 @@
 """Multi-node simulator: N beacon nodes + validator clients in one process
 over the LocalNetwork — the reference's testing/simulator liveness checks
-(checks.rs: finalization, onboarding/sync) without a cluster."""
+(checks.rs: finalization, onboarding/sync) without a cluster.
 
-import pytest
+The node orchestration lives in lighthouse_tpu.sim (shared with the
+adversarial scenario suite); this module keeps the happy-path checks."""
 
 from lighthouse_tpu.client import Client, ClientConfig
-from lighthouse_tpu.network import LocalNetwork, NetworkService
+from lighthouse_tpu.network import NetworkService
+from lighthouse_tpu.sim import build_sim, run_slot
 from lighthouse_tpu.types import MINIMAL_PRESET
-from lighthouse_tpu.validator_client import BeaconNodeApi, ValidatorClient, ValidatorStore
 
 N_NODES = 3
 N_VALIDATORS = 12  # split 4/4/4 across nodes
 
 
-def build_sim():
-    net = LocalNetwork()
-    nodes = []
-    for n in range(N_NODES):
-        client = Client(
-            ClientConfig(bls_backend="fake", http_enabled=False, interop_validators=N_VALIDATORS)
-        )
-        service = NetworkService(f"node{n}", client, net)
-        api = BeaconNodeApi(client.chain, op_pool=client.op_pool)
-        store = ValidatorStore(client.ctx)
-        for i in range(n, N_VALIDATORS, N_NODES):  # interleaved split
-            sk, _ = client.ctx.bls.interop_keypair(i)
-            store.add_validator(sk)
-        vc = ValidatorClient(api, store)
-        nodes.append((client, service, vc))
-    return net, nodes
-
-
-class PublishingApi:
-    """Wraps a node's duty results so produced blocks/attestations also go
-    out over gossip (the BN's publish path)."""
-
-
-def run_slot(nodes, slot):
-    # 1. every node ingests pending gossip first (previous slot's messages)
-    for client, service, _ in nodes:
-        client.chain.slot_clock.set_slot(slot)
-        client.chain.fork_choice.on_tick(slot)
-        service.process_pending()
-    # 2. duties: publish whatever each VC produces
-    for client, service, vc in nodes:
-        # capture publishes by hooking the api seam
-        orig_pub_block = vc.api.publish_block
-        orig_pub_att = vc.api.publish_attestation
-
-        def pub_block(signed, _orig=orig_pub_block, _svc=service):
-            root = _orig(signed)
-            _svc.publish_block(signed)
-            return root
-
-        def pub_att(att, _orig=orig_pub_att, _svc=service):
-            ok = _orig(att)
-            if ok:
-                _svc.publish_attestation(att)
-            return ok
-
-        vc.api.publish_block = pub_block
-        vc.api.publish_attestation = pub_att
-        try:
-            vc.on_slot(slot)
-        finally:
-            vc.api.publish_block = orig_pub_block
-            vc.api.publish_attestation = orig_pub_att
-    # 3. deliver this slot's gossip everywhere
-    for client, service, _ in nodes:
-        service.process_pending()
-
-
 def test_three_nodes_reach_same_finality():
-    net, nodes = build_sim()
+    net, nodes = build_sim(N_NODES, N_VALIDATORS)
     spe = MINIMAL_PRESET.slots_per_epoch
     for slot in range(1, 4 * spe + 1):
         run_slot(nodes, slot)
@@ -86,11 +29,10 @@ def test_three_nodes_reach_same_finality():
 
 
 def test_late_joining_node_syncs():
-    net, nodes = build_sim()
+    net, nodes = build_sim(N_NODES, N_VALIDATORS)
     spe = MINIMAL_PRESET.slots_per_epoch
     for slot in range(1, spe + 1):
         run_slot(nodes, slot)
-    head_before = nodes[0][0].chain.head_root
 
     # a fourth node joins with only genesis and hears the NEXT block
     late = Client(

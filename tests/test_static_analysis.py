@@ -194,6 +194,17 @@ def launch(fn):
     t.join()
 """
 
+THREAD_GOOD_COMPREHENSION_JOINED = """
+import threading
+
+def launch(fns):
+    threads = [threading.Thread(target=fn) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+"""
+
 
 def test_thread_hygiene_detects_swallowed_blanket_except():
     findings = run_checker(ThreadHygieneChecker(), THREAD_BAD_SWALLOW)
@@ -211,6 +222,13 @@ def test_thread_hygiene_detects_unjoinable_nondaemon_thread():
     assert len(findings) == 1
     assert "stop/join" in findings[0].message
     assert run_checker(ThreadHygieneChecker(), THREAD_GOOD_JOINED) == []
+
+
+def test_thread_hygiene_accepts_comprehension_built_joined_pool():
+    # threads built in a comprehension and joined via the container's loop
+    # variable are joinable — the container assignment + `for t in threads:
+    # t.join()` resolve as a stop/join path
+    assert run_checker(ThreadHygieneChecker(), THREAD_GOOD_COMPREHENSION_JOINED) == []
 
 
 # -- trace-purity --------------------------------------------------------------
