@@ -38,6 +38,14 @@ Montgomery ladder, Karabina compressed `_pow_abs_x` vs the plain Fp12
 square-and-multiply chain, and shared-batch-inversion affine conversion vs
 per-group `to_affine` — each pair output-checked before it is timed.
 `scripts/profile_stages.py --kernel` prints the matching stage split.
+
+Provenance: every emitted JSON (headline line and BENCH_FULL.json) carries a
+`provenance` block — the active backend fingerprint from
+`jax_backend.api.device_fingerprint()` (platform, device kind, chip count,
+jit-cache state, coalescer config) — so a recorded number can never be
+mistaken for a different device's. `--require-device` makes a CPU-only
+outcome exit 1 (the one exception to the never-nonzero contract), and any
+CPU-fallback measurement is flagged `"degraded": true`.
 """
 
 import json
@@ -489,15 +497,23 @@ def child_main() -> None:
     b = bls.backend("jax")
     run_all = "--all" in sys.argv
 
+    # every BENCH_*.json / headline line carries the backend fingerprint so
+    # a number can never be mistaken for a different device's; fingerprinted
+    # AFTER the measurement so the jit-cache state reflects the run
+    from lighthouse_tpu.crypto.bls.jax_backend import api as japi
+
     if "--staging" in sys.argv and not run_all:
         # staging-only invocation: the host fast-path scenario is the line
         out = bench_staging(b)
         out["platform"] = jax.devices()[0].platform
+        out["provenance"] = japi.device_fingerprint()
         print(json.dumps(out))
         return
 
     if "--kernel" in sys.argv and not run_all:
-        print(json.dumps(bench_kernel()))
+        out = bench_kernel()
+        out["provenance"] = japi.device_fingerprint()
+        print(json.dumps(out))
         return
 
     results = {}
@@ -512,9 +528,11 @@ def child_main() -> None:
         results["cpu_oracle"] = bench_cpu_oracle()
     headline = bench_config2(b)
     headline["platform"] = jax.devices()[0].platform
+    headline["provenance"] = japi.device_fingerprint()
     results["config2"] = headline
 
     if run_all:
+        results["provenance"] = headline["provenance"]
         out = pathlib.Path(__file__).resolve().parent / "BENCH_FULL.json"
         out.write_text(json.dumps(results, indent=2) + "\n")
         for k, v in results.items():
@@ -554,14 +572,18 @@ def _run_child(extra_env, timeout_sec, args=(), drop_env=()):
 
 
 def main() -> None:
-    """Wedge-proof orchestrator: NEVER exits nonzero, ALWAYS prints one JSON
-    line, regardless of accelerator-tunnel health (two prior rounds lost
-    their perf record to rc=1 benches — see VERDICT round 4, Weak #1)."""
+    """Wedge-proof orchestrator: ALWAYS prints one JSON line regardless of
+    accelerator-tunnel health, and NEVER exits nonzero (two prior rounds
+    lost their perf record to rc=1 benches — see VERDICT round 4, Weak #1)
+    — with ONE exception: `--require-device` makes a CPU-only outcome exit 1
+    instead of silently publishing a CPU number as if it were the device's.
+    Any fallback measurement is flagged `"degraded": true` either way."""
     if "--child" in sys.argv:
         child_main()
         return
 
     run_all = [f for f in ("--all", "--staging") if f in sys.argv]
+    require_device = "--require-device" in sys.argv
     errors = []
 
     if "--kernel" in sys.argv and "--all" not in sys.argv:
@@ -589,13 +611,16 @@ def main() -> None:
     import subprocess
 
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", 90))
+    probe_platform = None
     try:
         probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", "import jax; print(jax.devices()[0].platform)"],
             timeout=probe_timeout, capture_output=True,
         )
         accel_alive = probe.returncode == 0
-        if not accel_alive:
+        if accel_alive:
+            probe_platform = probe.stdout.decode(errors="replace").strip() or None
+        else:
             tail = (probe.stderr or b"").decode(errors="replace").strip().splitlines()
             errors.append(
                 "probe: backend init failed"
@@ -604,6 +629,24 @@ def main() -> None:
     except subprocess.TimeoutExpired:
         accel_alive = False
         errors.append(f"probe: tunnel wedged (no device list in {probe_timeout}s)")
+
+    if require_device and (not accel_alive or probe_platform == "cpu"):
+        # fast-fail BEFORE any bench work: the caller asked for a device
+        # number and the only platform on offer is the CPU (or nothing)
+        reason = (
+            "; ".join(errors)
+            if errors
+            else f"probe saw platform {probe_platform!r}, not an accelerator"
+        )
+        print(json.dumps({
+            "metric": "verify_signature_sets_128x1_throughput",
+            "value": 0.0,
+            "unit": "sets_per_sec",
+            "degraded": True,
+            "error": f"--require-device: {reason}",
+            "provenance": {"platform": probe_platform},
+        }))
+        sys.exit(1)
 
     # Attempt 1 + one retry on the default (accelerator) platform. The child
     # import of jax is what wedges when the tunnel is down, so the deadline
@@ -635,6 +678,7 @@ def main() -> None:
         drop_env=("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE"),
     )
     if result is not None:
+        result["degraded"] = True
         result["error"] = (
             "; ".join(errors)
             + " — CPU-platform fallback measurement ("
@@ -642,6 +686,10 @@ def main() -> None:
             + ", cached small-batch kernels)"
         )
         print(json.dumps(result))
+        if require_device:
+            # the device probe passed but every accelerator attempt failed:
+            # a CPU number is not the number the caller asked for
+            sys.exit(1)
         return
     errors.append(f"cpu fallback: {err}")
 
@@ -652,17 +700,25 @@ def main() -> None:
             "metric": "staging_warm_vs_cold_speedup",
             "value": 0.0,
             "unit": "x",
+            "degraded": True,
             "error": "; ".join(errors),
+            "provenance": {"platform": probe_platform},
         }))
+        if require_device:
+            sys.exit(1)
         return
     print(json.dumps({
         "metric": "verify_signature_sets_128x1_throughput",
         "value": 0.0,
         "unit": "sets_per_sec",
         "vs_baseline": 0.0,
+        "degraded": True,
         "error": "; ".join(errors),
+        "provenance": {"platform": probe_platform},
         "last_known_tpu_sets_per_sec": 213.27,
     }))
+    if require_device:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -112,7 +112,7 @@ class PipelinedGossipVerifier:
     def __init__(self, chain, apply_to_fork_choice: bool = True):
         self.chain = chain
         self.apply_to_fork_choice = apply_to_fork_choice
-        self._pending = []  # (items, results, staged, future|None)
+        self._pending = []  # (items, results, staged, future|None, corr_meta)
         # roots of attestations staged this cycle but not yet resolved:
         # IDENTICAL duplicates across batches in one drain are dropped
         # without re-verification, while a different attestation from the
@@ -124,7 +124,8 @@ class PipelinedGossipVerifier:
 
     def submit(self, attestations) -> None:
         results, staged = _stage_gossip_attestations(self.chain, attestations)
-        kept = []
+        recorder = getattr(self.chain, "flight_recorder", None)
+        kept, corr = [], []
         for row in staged:
             i, _indexed, _ = row
             att = attestations[i]
@@ -134,6 +135,13 @@ class PipelinedGossipVerifier:
                 continue
             self._provisional.add(root)
             kept.append(row)
+            # correlate: the id minted at gossip admission is bound to this
+            # root; record the staging hop and ride (recorder, id) alongside
+            # the set so the coalescer can mark its batch/verdict hops
+            cid = recorder.lookup(bytes(root)) if recorder is not None else None
+            if cid is not None:
+                recorder.record(cid, "staged", sets=1)
+            corr.append((recorder, cid) if cid is not None else None)
         staged = kept
         future = None
         if staged:
@@ -145,12 +153,19 @@ class PipelinedGossipVerifier:
                 # cross-caller coalescing: the batch shares a device
                 # dispatch with whatever else is in flight, and a failed
                 # shared batch bisects to per-set verdicts
-                future = svc.submit(sets)
-            elif submit_async is not None:
-                future = submit_async(sets)
+                future = svc.submit(sets, corr_meta=corr)
             else:
-                future = _SyncVerdict(bls.verify_signature_sets(sets))
-        self._pending.append((list(attestations), results, staged, future))
+                from ..common.metrics import BLS_SETS_TOTAL
+
+                # the coalescer counts its sets in _dispatch; direct paths
+                # count here so the ledger's throughput derivation sees
+                # every gossip set regardless of backend
+                BLS_SETS_TOTAL.inc(len(sets))
+                if submit_async is not None:
+                    future = submit_async(sets)
+                else:
+                    future = _SyncVerdict(bls.verify_signature_sets(sets))
+        self._pending.append((list(attestations), results, staged, future, corr))
 
     def _verdicts(self, staged, future) -> list:
         """Normalize a batch future into per-set verdicts: BatchFuture
@@ -171,7 +186,7 @@ class PipelinedGossipVerifier:
         cannot discard the other batches' verdicts."""
         pending, self._pending = self._pending, []
         self._provisional.clear()
-        for items, results, staged, future in pending:
+        for items, results, staged, future, corr in pending:
             try:
                 _resolve_and_apply(
                     self.chain,
@@ -185,6 +200,10 @@ class PipelinedGossipVerifier:
 
                 PROCESSOR_ITEMS_DROPPED.inc()
                 continue
+            for (i, _, _), meta in zip(staged, corr):
+                if meta is not None:
+                    recorder, cid = meta
+                    recorder.record(cid, "verdict", ok=results[i] is True)
             for att, res in zip(items, results):
                 try:
                     route(att, res)
@@ -323,30 +342,51 @@ def _batch_verify_gossip_aggregates(chain, aggregates, apply_to_fork_choice: boo
         except (AttestationError, StateTransitionError) as e:
             results[i] = e
 
+    # correlate: the admission-time id is bound to the signed aggregate's
+    # root; all three of an aggregate's sets share its one correlation id
+    recorder = getattr(chain, "flight_recorder", None)
+    corr_of_row: dict[int, str] = {}
+    if recorder is not None:
+        for i, signed, _, sets, _ in staged:
+            cid = recorder.lookup(bytes(type(signed).hash_tree_root(signed)))
+            if cid is not None:
+                recorder.record(cid, "staged", sets=len(sets))
+                corr_of_row[i] = cid
+
     if staged:
         svc = active_for(ctx.bls)
         if svc is not None:
             # coalesced: one verdict per individual set (bisection blame);
             # an aggregate is admitted iff all three of its sets verify
             all_sets = [s for _, _, _, sets, _ in staged for s in sets]
-            verdicts = svc.submit(all_sets).result()
+            all_meta = [
+                (recorder, corr_of_row[i]) if i in corr_of_row else None
+                for i, _, _, sets, _ in staged
+                for _ in sets
+            ]
+            verdicts = svc.submit(all_sets, corr_meta=all_meta).result()
             pos = 0
             for i, _, _, sets, _ in staged:
                 ok = all(verdicts[pos : pos + len(sets)])
                 pos += len(sets)
                 results[i] = True if ok else AttestationError("invalid signature")
-        elif ctx.bls.verify_signature_sets(
-            [s for _, _, _, sets, _ in staged for s in sets]
-        ):
-            for i, _, _, _, _ in staged:
-                results[i] = True
         else:
-            for i, _, _, sets, _ in staged:
-                results[i] = (
-                    True
-                    if ctx.bls.verify_signature_sets(sets)
-                    else AttestationError("invalid signature")
-                )
+            from ..common.metrics import BLS_SETS_TOTAL
+
+            all_sets = [s for _, _, _, sets, _ in staged for s in sets]
+            BLS_SETS_TOTAL.inc(len(all_sets))
+            if ctx.bls.verify_signature_sets(all_sets):
+                for i, _, _, _, _ in staged:
+                    results[i] = True
+            else:
+                for i, _, _, sets, _ in staged:
+                    results[i] = (
+                        True
+                        if ctx.bls.verify_signature_sets(sets)
+                        else AttestationError("invalid signature")
+                    )
+        for i, cid in corr_of_row.items():
+            recorder.record(cid, "verdict", ok=results[i] is True)
 
     for i, signed, indexed, _, data_root in staged:
         if results[i] is True:

@@ -45,9 +45,22 @@ class BeaconChain:
         from .events import EventBus
         from .validator_monitor import ValidatorMonitor
 
+        from ..common.flight_recorder import FlightRecorder
+        from ..common.slot_ledger import SlotLedger
+
         self.ctx = ctx
         self.store = store if store is not None else MemoryStore()
         self.slot_clock = slot_clock if slot_clock is not None else ManualSlotClock()
+        # per-chain observability (ISSUE 17): correlated event ring +
+        # slot-budget accountant, ticked by the slot clock's listener hook
+        self.flight_recorder = FlightRecorder()
+        self.slot_ledger = SlotLedger(
+            seconds_per_slot=float(ctx.spec.seconds_per_slot),
+            recorder=self.flight_recorder,
+        )
+        listeners = getattr(self.slot_clock, "listeners", None)
+        if listeners is not None:
+            listeners.append(self.slot_ledger.on_slot)
         self.events = EventBus()
         self.validator_monitor = ValidatorMonitor(
             slots_per_epoch=ctx.preset.slots_per_epoch

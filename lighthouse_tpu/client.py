@@ -289,6 +289,9 @@ class Client:
     def shutdown(self) -> None:
         """Clean shutdown: persist chain head (Drop for BeaconChain,
         beacon_chain.rs:4590), stop servers."""
+        # close the final slot window so its record (and any deadline-miss
+        # dump) exists before the process goes away
+        self.chain.slot_ledger.close()
         if self.coalescer is not None:
             from .crypto.bls.batch_verifier import release
 

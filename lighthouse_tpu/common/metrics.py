@@ -403,3 +403,14 @@ BLS_BISECTION_BLAMED_SETS_TOTAL = REGISTRY.counter(
     "lighthouse_tpu_bls_bisection_blamed_sets_total",
     "Signature sets individually blamed (rejected) by bisection",
 )
+
+# Device provenance (ISSUE 17): info-style family — the value is always 1,
+# the identity lives in the labels, so a platform flip (accelerator wedge
+# falling back to CPU) shows up as a NEW labelled child on the scrape
+# instead of a silently different measurement.
+DEVICE_PROVENANCE_INFO = REGISTRY.gauge_vec(
+    "lighthouse_tpu_device_provenance_info",
+    "Active BLS backend fingerprint (value 1; identity in the platform / "
+    "device_kind / chip_count labels)",
+    ("platform", "device_kind", "chip_count"),
+)

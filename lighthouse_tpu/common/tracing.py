@@ -63,6 +63,12 @@ class Tracer:
         self._stage_histogram = stage_histogram
         self._slowest: list[Span] = []  # sorted slowest-first, len <= keep
         self._lock = threading.Lock()
+        # cumulative EXCLUSIVE (self) time per stage: a span's duration
+        # minus its children's — non-overlapping within a thread, so
+        # windowed deltas sum to at most wall time. The slot-SLO ledger
+        # (common/slot_ledger.py) diffs this dict at slot boundaries;
+        # monotonic by design, so reset() leaves it alone.
+        self._self_times: dict[str, float] = {}
 
     def _stack(self) -> list:
         stack = getattr(self._local, "stack", None)
@@ -83,6 +89,11 @@ class Tracer:
             s.duration = time.perf_counter() - s.started_at
             stack.pop()
             self._stage_histogram.labels(stage=name).observe(s.duration)
+            child_s = sum(c.duration or 0.0 for c in s.children)
+            with self._lock:
+                self._self_times[name] = self._self_times.get(name, 0.0) + max(
+                    0.0, s.duration - child_s
+                )
             if not stack:  # a completed root trace
                 self._record_root(s)
 
@@ -112,9 +123,17 @@ class Tracer:
             }
         return out
 
+    def self_time_report(self) -> dict[str, float]:
+        """{stage: cumulative exclusive seconds} — duration minus children,
+        so summing stages never double-counts nested spans. Monotonic: the
+        slot ledger attributes a slot by diffing two snapshots."""
+        with self._lock:
+            return dict(self._self_times)
+
     def reset(self) -> None:
         """Drop the slow-trace ring (tests; the stage histogram is owned by
-        the metrics registry and is NOT cleared here)."""
+        the metrics registry and is NOT cleared here; self-times stay —
+        the slot ledger depends on their monotonicity)."""
         with self._lock:
             self._slowest.clear()
 

@@ -119,6 +119,19 @@ class _Entry:
     sets: list
     future: BatchFuture
     submitted_at: float = field(default_factory=time.monotonic)
+    # flight-recorder correlation, aligned with `sets`: each item is None
+    # (uncorrelated, e.g. block-import batches) or a (recorder, corr_id)
+    # pair recorded at batch formation / dispatch / blame / verdict
+    meta: list | None = None
+
+
+def _record_meta(meta_row, event: str, **fields) -> None:
+    """Emit one flight-recorder event for a correlated set (None = the
+    submission was never correlated; nothing to record)."""
+    if meta_row is None:
+        return
+    recorder, corr_id = meta_row
+    recorder.record(corr_id, event, **fields)
 
 
 class _Ready:
@@ -208,16 +221,25 @@ class BatchVerifier:
 
     # -- submission ------------------------------------------------------------
 
-    def submit(self, sets) -> BatchFuture:
+    def submit(self, sets, corr_meta=None) -> BatchFuture:
         """Submit signature sets; the future resolves to per-set verdicts.
         On a stopped service this degrades to a synchronous direct verify
-        (single-set fallback) so callers never need a second code path."""
+        (single-set fallback) so callers never need a second code path.
+
+        `corr_meta` (optional) aligns with `sets`: None or a
+        (flight_recorder, corr_id) pair per set — the coalescer records the
+        set's batch-formation/dispatch/blame/verdict hops against that id."""
         sets = list(sets)
         fut = BatchFuture()
         if not sets:
             fut._resolve([])
             return fut
-        entry = _Entry(sets, fut)
+        meta = None
+        if corr_meta is not None:
+            meta = list(corr_meta)
+            if len(meta) != len(sets):
+                meta = None  # misaligned metadata is worse than none
+        entry = _Entry(sets, fut, meta=meta)
         with self._lock:
             running = self._running
             if running:
@@ -340,6 +362,9 @@ class BatchVerifier:
         now = time.monotonic()
         for e in entries:
             BLS_COALESCE_WAIT_SECONDS.observe(max(0.0, now - e.submitted_at))
+            if e.meta is not None:
+                for m in e.meta:
+                    _record_meta(m, "batch_formed", batch_sets=n_sets)
         BLS_COALESCED_BATCH_SIZE.observe(n_sets)
         BLS_COALESCED_DISPATCHES_TOTAL.inc()
         BLS_SETS_TOTAL.inc(n_sets)
@@ -382,6 +407,10 @@ class BatchVerifier:
 
                 BLS_COALESCER_INTERNAL_ERRORS_TOTAL.inc()
                 fut = _Ready(False)
+            for e in entries:
+                if e.meta is not None:
+                    for m in e.meta:
+                        _record_meta(m, "device_dispatch", batch_sets=len(sets))
             self._resolve_q.put((entries, sets, fut, formed_at))
 
     # -- resolver: verdicts + bisection blame ----------------------------------
@@ -433,6 +462,11 @@ class BatchVerifier:
         pos = 0
         for e in entries:
             k = len(e.sets)
+            if e.meta is not None:
+                for m, v in zip(e.meta, verdicts[pos : pos + k]):
+                    if not ok and not v:
+                        _record_meta(m, "bisect_blame")
+                    _record_meta(m, "set_verdict", ok=bool(v))
             e.future._resolve(verdicts[pos : pos + k])
             pos += k
 

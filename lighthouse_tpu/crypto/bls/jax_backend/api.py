@@ -62,6 +62,47 @@ def _coalescer():
     return active_for(sys.modules[__name__])
 
 
+def device_fingerprint(refresh_gauge: bool = True) -> dict:
+    """Backend provenance (ISSUE 17): the fingerprint bench.py stamps into
+    every BENCH_*.json and /metrics exports as an info-style gauge (value 1,
+    identity in the labels). Host-side only — never called from (or
+    reachable by) the jitted kernels, so trace purity is untouched.
+
+    The r05 bench wedge silently fell back to CPU and the run was recorded
+    as device data; with the platform/device identity stamped into the
+    artifact, that mistake cannot repeat."""
+    from ..batch_verifier import DEFAULT_MAX_WAIT, DEFAULT_S_BUCKET
+    from ....common.metrics import DEVICE_PROVENANCE_INFO
+
+    devices = jax.devices()
+    dev = devices[0]
+    cache = _verify_kernel.cache_info()
+    svc = _coalescer()
+    info = {
+        "platform": str(dev.platform),
+        "device_kind": str(getattr(dev, "device_kind", dev.platform)),
+        "chip_count": len(devices),
+        "backend": str(jax.default_backend()),
+        "jit_cache": {
+            "verify_kernels_cached": int(cache.currsize),
+            "hits": int(cache.hits),
+            "misses": int(cache.misses),
+        },
+        "coalescer": {
+            "running": svc is not None,
+            "s_bucket": int(svc.s_bucket) if svc is not None else DEFAULT_S_BUCKET,
+            "max_wait": float(svc.max_wait) if svc is not None else DEFAULT_MAX_WAIT,
+        },
+    }
+    if refresh_gauge:
+        DEVICE_PROVENANCE_INFO.labels(
+            platform=info["platform"],
+            device_kind=info["device_kind"],
+            chip_count=str(info["chip_count"]),
+        ).set(1)
+    return info
+
+
 class Signature(_ref.Signature):
     """Signature whose verification runs on the accelerator.
 

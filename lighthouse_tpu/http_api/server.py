@@ -160,6 +160,13 @@ class _Handler(BaseHTTPRequestHandler):
             # per-validator attribution for registered keys (the reference's
             # /lighthouse/ui/validator_metrics UI endpoint)
             self._send(200, _data(chain.validator_monitor.ui_payload()))
+        elif parts == ["lighthouse", "ui", "slot_ledger"]:
+            # per-slot budget attribution (common/slot_ledger.py)
+            self._send(200, _data(chain.slot_ledger.ui_payload()))
+        elif parts == ["lighthouse", "ui", "flight_recorder"]:
+            # correlated event ring; ?corr_id= filters to one message's path
+            corr_id = q.get("corr_id", [None])[0]
+            self._send(200, _data(chain.flight_recorder.dump(corr_id)))
         elif parts == ["eth", "v1", "node", "health"]:
             self._send(200, b"")
         elif parts == ["eth", "v1", "node", "version"]:

@@ -58,12 +58,13 @@ class NetworkService:
     def on_gossip(self, topic: Topic, message) -> None:
         p = self.client.processor
         if topic == Topic.BEACON_BLOCK:
+            self._admit_to_recorder("block", message)
             p.submit(WorkType.GOSSIP_BLOCK, message)
         elif topic in (Topic.BEACON_ATTESTATION, Topic.BEACON_AGGREGATE_AND_PROOF):
+            is_att = topic == Topic.BEACON_ATTESTATION
+            self._admit_to_recorder("attestation" if is_att else "aggregate", message)
             p.submit(
-                WorkType.GOSSIP_ATTESTATION
-                if topic == Topic.BEACON_ATTESTATION
-                else WorkType.GOSSIP_AGGREGATE,
+                WorkType.GOSSIP_ATTESTATION if is_att else WorkType.GOSSIP_AGGREGATE,
                 message,
             )
         elif topic == Topic.SYNC_COMMITTEE:
@@ -76,6 +77,21 @@ class NetworkService:
             self.client.op_pool.insert_proposer_slashing(message)
         elif topic == Topic.ATTESTER_SLASHING:
             self.client.op_pool.insert_attester_slashing(message)
+
+    def _admit_to_recorder(self, kind: str, message) -> None:
+        """Mint a flight-recorder correlation id at gossip admission and
+        bind it to the message's hash-tree-root — the verification pipeline
+        (attestation_processing / batch_verifier) looks the id up by root,
+        so the message rides the work queues untouched."""
+        try:
+            key = bytes(type(message).hash_tree_root(message))
+        except Exception:  # noqa: BLE001 — junk payloads (adversarial
+            # frames) cannot be rooted; they fail later behind the drain's
+            # hostile-input boundary and there is nothing to correlate
+            return
+        recorder = self.client.chain.flight_recorder
+        corr_id = recorder.mint(kind, node=self.node_id)
+        recorder.bind(key, corr_id)
 
     def connect_discovered(self, discovery) -> int:
         """Dial every routing-table peer advertising a TCP (gossip) port —

@@ -200,16 +200,21 @@ class BeaconProcessor:
         from ..common.tracing import span
 
         n = 0
-        while max_batches is None or n < max_batches:
-            batch = self.next_batch()
-            if batch is None:
-                break
-            kind = batch.work_type.name.lower()
-            with PROCESSOR_HANDLE_SECONDS.labels(kind=kind).time(), span(
-                f"processor_handle_{kind}"
-            ):
-                handlers[batch.work_type](batch.items)
-            n += 1
+        # the enclosing drain span times the scheduling overhead BETWEEN
+        # handler batches (queue pops, priority scan); the slot ledger
+        # attributes its exclusive time separately from the handlers', so
+        # "the drain loop itself is slow" is observable per slot
+        with span("processor_drain"):
+            while max_batches is None or n < max_batches:
+                batch = self.next_batch()
+                if batch is None:
+                    break
+                kind = batch.work_type.name.lower()
+                with PROCESSOR_HANDLE_SECONDS.labels(kind=kind).time(), span(
+                    f"processor_handle_{kind}"
+                ):
+                    handlers[batch.work_type](batch.items)
+                n += 1
         if self.coalescer is not None:
             # the drain produced no more work: the device is about to go
             # idle, so flush any partially-filled coalesced batch now

@@ -185,6 +185,24 @@ class Simulation:
         self.log("state", **snap)
         return snap
 
+    def observability(self) -> list[dict]:
+        """Per-node slot-ledger records + flight-recorder dump. These carry
+        wall-clock timestamps, so they are NEVER part of the byte-
+        reproducible event log — scripts/sim.py --json emits them in a
+        separate envelope key next to the events. Valid after close():
+        shutdown closes each node's final slot window first."""
+        out = []
+        for node in self.nodes:
+            chain = node.chain
+            out.append(
+                {
+                    "node": node.node_id,
+                    "slot_ledger": chain.slot_ledger.ui_payload(),
+                    "flight_recorder": chain.flight_recorder.dump(),
+                }
+            )
+        return out
+
     def _settle(self, deadline: float = 15.0, quiet_rounds: int = 2) -> None:
         """Socket-mode barrier: drain every node until no new work arrives
         for `quiet_rounds` consecutive polls (submitted counters stable AND

@@ -32,6 +32,11 @@ Run: python scripts/profile_stages.py   (on the bench platform)
          batch-inversion affine conversion vs per-group to_affine — each
          its own jitted program, output-checked before timing.
          Env: PROFILE_KERNEL_SETS (8), PROFILE_REPS (5).
+     python scripts/profile_stages.py --slot
+         slot-SLO ledger budget table: runs a fake-backend harness chain
+         for a few slots and prints the per-stage slot-budget attribution
+         (common.slot_ledger) next to the raw span breakdown. Env:
+         PROFILE_SLOT_VALIDATORS (16), PROFILE_SLOTS (8).
      python scripts/profile_stages.py --opcounts
          per-kernel jaxpr primitive counts from the analyzer registry
          (trace-only, no device) next to the committed budget baseline —
@@ -57,6 +62,25 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 
 N_SETS = int(os.environ.get("PROFILE_N_SETS", "128"))
 REPS = int(os.environ.get("PROFILE_REPS", "5"))
+
+
+def print_stage_table(
+    report,
+    title="span-derived per-stage breakdown (common.tracing):",
+    width=22,
+):
+    """THE stage-table printer every mode shares. Rows are
+    {stage: {count, total_s, mean_s}} — the exact shape both
+    TRACER.stage_report() and SlotLedger.stage_report() emit, so the span
+    breakdown and the --slot ledger budget table render identically."""
+    print(f"\n{title}", flush=True)
+    for stage, rec in report.items():
+        print(
+            f"  {stage:{width}s} n={rec['count']:3d}"
+            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
+            f"  total={rec['total_s'] * 1e3:9.2f} ms",
+            flush=True,
+        )
 
 
 def med(fn, label, reps=REPS):
@@ -147,14 +171,7 @@ def coalesce_main() -> None:
               flush=True)
     print(f"batch-size histogram n   {BLS_COALESCED_BATCH_SIZE.count}", flush=True)
 
-    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
-    for stage, rec in TRACER.stage_report().items():
-        print(
-            f"  {stage:22s} n={rec['count']:3d}"
-            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
-            f"  total={rec['total_s'] * 1e3:9.2f} ms",
-            flush=True,
-        )
+    print_stage_table(TRACER.stage_report())
 
 
 def staging_main() -> None:
@@ -215,14 +232,7 @@ def staging_main() -> None:
         dm = c1[cache][1] - c0[cache][1]
         print(f"  {cache:10s} hits={dh:8.0f}  misses={dm:8.0f}", flush=True)
 
-    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
-    for stage, rec in TRACER.stage_report().items():
-        print(
-            f"  {stage:22s} n={rec['count']:3d}"
-            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
-            f"  total={rec['total_s'] * 1e3:9.2f} ms",
-            flush=True,
-        )
+    print_stage_table(TRACER.stage_report())
 
 
 def kernel_main() -> None:
@@ -314,14 +324,7 @@ def kernel_main() -> None:
     print(f"to-affine batch_inv       {t_s * 1e3:9.2f} ms", flush=True)
     print(f"to-affine separate        {t_p * 1e3:9.2f} ms   ({t_p / t_s:.2f}x)", flush=True)
 
-    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
-    for stage, rec in TRACER.stage_report().items():
-        print(
-            f"  {stage:28s} n={rec['count']:3d}"
-            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
-            f"  total={rec['total_s'] * 1e3:9.2f} ms",
-            flush=True,
-        )
+    print_stage_table(TRACER.stage_report(), width=28)
 
 
 def print_opcounts() -> None:
@@ -358,6 +361,40 @@ def print_opcounts() -> None:
             f"  {top_s}  (trace {trace_s:.1f}s)",
             flush=True,
         )
+
+
+def slot_main() -> None:
+    """Slot-SLO ledger budget table: drive a harness chain for a few slots
+    on the fake backend and print the per-stage slot-budget attribution
+    (common.slot_ledger) next to the raw span breakdown, through the one
+    shared table printer. Env: PROFILE_SLOT_VALIDATORS (16),
+    PROFILE_SLOTS (8)."""
+    from lighthouse_tpu.chain import BeaconChainHarness
+    from lighthouse_tpu.common.tracing import TRACER
+    from lighthouse_tpu.state_transition import TransitionContext
+
+    n_val = int(os.environ.get("PROFILE_SLOT_VALIDATORS", "16"))
+    n_slots = int(os.environ.get("PROFILE_SLOTS", "8"))
+
+    h = BeaconChainHarness(n_val, TransitionContext.minimal("fake"))
+    h.extend_chain(n_slots)
+    led = h.chain.slot_ledger
+    led.close()  # close the final window so every slot has a record
+
+    records = led.records()
+    missed = sum(1 for r in records if r["deadline_missed"])
+    wall = sum(r["wall_seconds"] for r in records)
+    print(
+        f"slots={len(records)}  validators={n_val}  "
+        f"budget={led.seconds_per_slot:.1f}s/slot  "
+        f"wall={wall * 1e3:9.2f} ms  deadline_misses={missed}",
+        flush=True,
+    )
+    print_stage_table(TRACER.stage_report())
+    print_stage_table(
+        led.stage_report(),
+        title="slot-ledger per-stage budget attribution (common.slot_ledger):",
+    )
 
 
 def main() -> None:
@@ -479,14 +516,7 @@ def main() -> None:
     # bench round and a /metrics scrape attribute identically
     from lighthouse_tpu.common.tracing import TRACER
 
-    print("\nspan-derived per-stage breakdown (common.tracing):", flush=True)
-    for stage, rec in TRACER.stage_report().items():
-        print(
-            f"  {stage:22s} n={rec['count']:3d}"
-            f"  mean={rec['mean_s'] * 1e3:9.2f} ms"
-            f"  total={rec['total_s'] * 1e3:9.2f} ms",
-            flush=True,
-        )
+    print_stage_table(TRACER.stage_report())
 
     # op-count deltas next to the wall-time deltas above (one run, both axes)
     if "--opcounts" in sys.argv:
@@ -504,6 +534,10 @@ if __name__ == "__main__":
         os.environ.pop("PALLAS_AXON_POOL_IPS", None)
         os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
         kernel_main()
+    elif "--slot" in sys.argv:
+        # ledger attribution is defined on the fake backend: no devices
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        slot_main()
     elif sys.argv[1:] == ["--opcounts"]:
         # standalone table is trace-only: pin the (uninitialized) backend to
         # CPU so trace constants never ride the tunnelled device link

@@ -11,7 +11,12 @@ two runs with the same seed must reproduce byte-for-byte.
     python scripts/sim.py --scenario equivocation_slashing --json
 
 `--replay` runs the scenario twice with the same seed and fails loudly if
-the event logs differ (the determinism guard, runnable by hand)."""
+the event logs differ (the determinism guard, runnable by hand).
+
+`--json` prints {"events": [...], "observability": [...]}: the byte-
+reproducible event log plus each node's slot-SLO ledger and flight-recorder
+dump. The observability half carries wall-clock timestamps and is therefore
+NOT part of the replay comparison."""
 
 from __future__ import annotations
 
@@ -39,9 +44,12 @@ def _list_scenarios() -> None:
         print(f"{'':<{width}}  {cls.description}")
 
 
-def _run_once(name: str, seed: int, net: str | None) -> str:
+def _run_once(name: str, seed: int, net: str | None) -> tuple[str, list]:
     sim = run_scenario(name, seed=seed, net=net)
-    return sim.event_log_json()
+    # observability (slot ledger + flight recorder per node) carries wall
+    # clocks, so it lives OUTSIDE the byte-reproducible event log: --replay
+    # compares only the log strings
+    return sim.event_log_json(), sim.observability()
 
 
 def main(argv=None) -> int:
@@ -61,7 +69,9 @@ def main(argv=None) -> int:
         help="run twice with the same seed and diff the event logs",
     )
     parser.add_argument(
-        "--json", action="store_true", help="print the raw event-log JSON"
+        "--json",
+        action="store_true",
+        help="print the event log plus per-node slot-ledger/flight-recorder JSON",
     )
     args = parser.parse_args(argv)
 
@@ -76,14 +86,14 @@ def main(argv=None) -> int:
         )
 
     try:
-        log = _run_once(args.scenario, args.seed, args.net)
+        log, obs = _run_once(args.scenario, args.seed, args.net)
     except ScenarioAssertion as e:
         print(f"FAIL {args.scenario} (seed {args.seed}): {e}", file=sys.stderr)
         return 1
 
     if args.replay:
         try:
-            second = _run_once(args.scenario, args.seed, args.net)
+            second, _ = _run_once(args.scenario, args.seed, args.net)
         except ScenarioAssertion as e:
             print(f"FAIL {args.scenario} replay (seed {args.seed}): {e}", file=sys.stderr)
             return 1
@@ -99,7 +109,13 @@ def main(argv=None) -> int:
             return 1
 
     if args.json:
-        print(log)
+        print(
+            json.dumps(
+                {"events": json.loads(log), "observability": obs},
+                sort_keys=True,
+                default=str,
+            )
+        )
     else:
         events = json.loads(log)
         for event in events:

@@ -26,7 +26,9 @@ REPO_ROOT = Path(__file__).resolve().parent.parent
 def _import_registering_modules():
     # modules that register on REGISTRY at import time
     import lighthouse_tpu.chain.validator_monitor  # noqa: F401
+    import lighthouse_tpu.common.flight_recorder  # noqa: F401
     import lighthouse_tpu.common.metrics  # noqa: F401
+    import lighthouse_tpu.common.slot_ledger  # noqa: F401
     import lighthouse_tpu.common.tracing  # noqa: F401
     import lighthouse_tpu.crypto.bls.batch_verifier  # noqa: F401
     import lighthouse_tpu.validator_client.validator_client  # noqa: F401
@@ -72,6 +74,28 @@ def test_staging_metric_families_are_registered():
         "lighthouse_tpu_bls_staging_cache_hits_total",
         "lighthouse_tpu_bls_staging_cache_misses_total",
         "lighthouse_tpu_bls_stage_seconds",
+    ):
+        assert expected in names, f"missing metric family {expected}"
+
+
+def test_observability_metric_families_are_registered():
+    """The slot-SLO ledger / flight-recorder / provenance families
+    (ISSUE 17) must exist on the global registry under their contracted
+    names."""
+    import lighthouse_tpu.common.flight_recorder  # noqa: F401
+    import lighthouse_tpu.common.slot_ledger  # noqa: F401
+    from lighthouse_tpu.common.metrics import REGISTRY
+
+    names = set(REGISTRY.names())
+    for expected in (
+        "lighthouse_tpu_slot_lateness_seconds",
+        "lighthouse_tpu_slot_stage_share_of_budget",
+        "lighthouse_tpu_slot_deadline_missed_total",
+        "lighthouse_tpu_slot_validators_supportable",
+        "lighthouse_tpu_flight_recorder_events_total",
+        "lighthouse_tpu_flight_recorder_dropped_events_total",
+        "lighthouse_tpu_flight_recorder_dumps_total",
+        "lighthouse_tpu_device_provenance_info",
     ):
         assert expected in names, f"missing metric family {expected}"
 

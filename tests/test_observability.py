@@ -460,6 +460,256 @@ def test_lockfile_release_never_unlinks_and_relocks(tmp_path):
     b.release()
 
 
+# -- slot-SLO ledger + flight recorder + provenance (ISSUE 17) -----------------
+
+
+def test_flight_recorder_ring_bounded_and_filterable():
+    from lighthouse_tpu.common.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=4, key_capacity=2)
+    a = rec.mint("attestation", node="n0")
+    b = rec.mint("aggregate")
+    assert a == "attestation-000000" and b == "aggregate-000001"  # deterministic
+    for i in range(4):
+        rec.record(a, f"e{i}")
+    # 6 events total through a 4-slot ring: the two oldest dropped, counted
+    assert len(rec.events()) == 4
+    assert rec.dropped == 2
+    assert all(r["corr_id"] == a for r in rec.events(a))
+    assert rec.events(b) == []  # b's "admitted" was evicted
+    # key map bounded too: oldest binding evicts first
+    rec.bind(b"k1", a)
+    rec.bind(b"k2", b)
+    rec.bind(b"k3", a)
+    assert rec.lookup(b"k1") is None
+    assert rec.lookup(b"k3") == a
+    dump = rec.dump(a)
+    assert dump["count"] == 4 and dump["dropped"] == 2
+
+
+def test_slot_ledger_attribution_sums_to_wall_time():
+    """The acceptance bar: per-stage attributions (including the residual)
+    sum to within 5% of the slot's measured wall time."""
+    import time as _t
+
+    from lighthouse_tpu.common.slot_ledger import SlotLedger
+
+    tr = Tracer(keep=8, stage_histogram=HistogramVec("sl_seconds", "", ("stage",)))
+    led = SlotLedger(seconds_per_slot=0.5, tracer=tr)
+    led.on_slot(1)
+    with tr.span("state_transition"):
+        _t.sleep(0.02)
+    with tr.span("gossip_attestation_verify"):
+        _t.sleep(0.01)
+    _t.sleep(0.01)  # un-spanned time -> the "unattributed" residual
+    led.on_slot(2)
+    led.on_slot(2)  # re-announcing the open slot is not a boundary
+    [rec] = led.records()
+    assert rec["slot"] == 1 and not rec["deadline_missed"]
+    total = sum(rec["stages"].values())
+    assert abs(total - rec["wall_seconds"]) <= 0.05 * rec["wall_seconds"]
+    assert rec["stages"]["state_transition"] >= 0.02
+    assert rec["stages"]["gossip_admission"] >= 0.01
+    assert rec["stages"]["unattributed"] >= 0.009
+    assert led.last_record()["slot"] == 1
+    # the shared-table shape profile_stages.print_stage_table renders
+    report = led.stage_report()
+    assert report["state_transition"]["count"] == 1
+    assert report["state_transition"]["total_s"] >= 0.02
+
+
+def test_deadline_miss_auto_dumps_correlated_path(tmp_path):
+    """A missed deadline must produce exactly ONE dump file carrying the
+    full correlated path of a signature set plus the missed slot record."""
+    import os as _os
+
+    from lighthouse_tpu.common.flight_recorder import FlightRecorder
+    from lighthouse_tpu.common.slot_ledger import SlotLedger
+
+    tr = Tracer(keep=8, stage_histogram=HistogramVec("dm_seconds", "", ("stage",)))
+    rec = FlightRecorder()
+    cid = rec.mint("attestation", node="n0")
+    rec.record(cid, "staged", sets=1)
+    rec.record(cid, "batch_formed", batch_sets=1)
+    rec.record(cid, "device_dispatch", batch_sets=1)
+    rec.record(cid, "set_verdict", ok=True)
+    rec.record(cid, "verdict", ok=True)
+
+    led = SlotLedger(
+        seconds_per_slot=0.0, recorder=rec, dump_dir=str(tmp_path), tracer=tr
+    )
+    led.on_slot(1)
+    led.on_slot(2)  # closes slot 1: wall > 0 = budget -> miss
+    files = sorted(_os.listdir(tmp_path))
+    assert len(files) == 1  # exactly one dump per miss
+    assert led.deadline_misses == 1
+    with open(tmp_path / files[0]) as f:
+        payload = json.load(f)
+    assert payload["slot_record"]["slot"] == 1
+    assert payload["slot_record"]["deadline_missed"]
+    path = [
+        e["event"]
+        for e in payload["flight_recorder"]["events"]
+        if e["corr_id"] == cid
+    ]
+    assert path == [
+        "admitted", "staged", "batch_formed", "device_dispatch",
+        "set_verdict", "verdict",
+    ]
+    assert led.last_record()["dump_path"] == str(tmp_path / files[0])
+    led.on_slot(3)  # a second miss dumps a second file
+    assert len(_os.listdir(tmp_path)) == 2
+    assert led.deadline_misses == 2
+
+
+def test_batch_verifier_correlates_dispatch_and_bisection_blame():
+    """Correlation ids survive the coalescer: batch formation, device
+    dispatch, bisection blame on the one bad set, per-set verdicts."""
+    from lighthouse_tpu.common.flight_recorder import FlightRecorder
+    from lighthouse_tpu.crypto.bls.batch_verifier import BatchVerifier
+
+    class StubBackend:
+        def verify_signature_sets(self, sets):
+            return all(s == "good" for s in sets)
+
+    rec = FlightRecorder()
+    cids = [rec.mint("attestation") for _ in range(3)]
+    svc = BatchVerifier(StubBackend(), max_wait=0.001).start()
+    try:
+        meta = [(rec, c) for c in cids]
+        verdicts = svc.submit(["good", "bad", "good"], corr_meta=meta).result(
+            timeout=10.0
+        )
+        # misaligned metadata is dropped, never misattributed
+        assert svc.submit(["good"], corr_meta=meta).result(timeout=10.0) == [True]
+    finally:
+        svc.stop()
+    assert verdicts == [True, False, True]
+    bad_path = [e["event"] for e in rec.events(cids[1])]
+    for hop in ("admitted", "batch_formed", "device_dispatch", "bisect_blame",
+                "set_verdict"):
+        assert hop in bad_path, hop
+    for good in (cids[0], cids[2]):
+        events = [e["event"] for e in rec.events(good)]
+        assert "bisect_blame" not in events
+        assert "set_verdict" in events
+    # a verdict event carries the per-set outcome
+    [v_bad] = [e for e in rec.events(cids[1]) if e["event"] == "set_verdict"]
+    assert v_bad["ok"] is False
+
+
+def test_sim_gossip_correlation_reaches_verdict():
+    """End-to-end over the in-process testnet: an id minted at gossip
+    admission shows up with staging and a final verdict on some node."""
+    from lighthouse_tpu.sim import SimConfig, Simulation
+
+    sim = Simulation(SimConfig(n_nodes=2, n_validators=8, net="local", seed=3))
+    try:
+        sim.run_slots(4)
+    finally:
+        sim.close()
+    complete = []
+    for node in sim.nodes:
+        by_cid = {}
+        for e in node.chain.flight_recorder.events():
+            by_cid.setdefault(e["corr_id"], set()).add(e["event"])
+        for cid, events in by_cid.items():
+            if cid.startswith("attestation") and {
+                "admitted", "staged", "verdict"
+            } <= events:
+                complete.append(cid)
+    assert complete, "no attestation completed the admitted->staged->verdict path"
+
+
+def test_device_provenance_fingerprint_matches_backend():
+    import jax
+
+    from lighthouse_tpu.common.metrics import DEVICE_PROVENANCE_INFO
+    from lighthouse_tpu.crypto.bls.jax_backend import api as japi
+
+    prov = japi.device_fingerprint()
+    dev = jax.devices()[0]
+    assert prov["platform"] == dev.platform
+    assert prov["chip_count"] == len(jax.devices())
+    assert prov["backend"] == jax.default_backend()
+    assert set(prov["jit_cache"]) == {"verify_kernels_cached", "hits", "misses"}
+    assert set(prov["coalescer"]) == {"running", "s_bucket", "max_wait"}
+    child = DEVICE_PROVENANCE_INFO.labels(
+        platform=prov["platform"],
+        device_kind=prov["device_kind"],
+        chip_count=str(prov["chip_count"]),
+    )
+    assert child.value == 1.0
+
+
+def test_ui_slot_ledger_and_flight_recorder_routes(monitored_chain):
+    h, _, srv = monitored_chain
+    status, resp = _get(srv.port, "/lighthouse/ui/slot_ledger")
+    assert status == 200
+    ledger = resp["data"]
+    assert ledger["seconds_per_slot"] == h.chain.slot_ledger.seconds_per_slot
+    # extend_chain(25) ticked the slot clock through 24 boundaries
+    assert len(ledger["slots"]) >= 20
+    for rec in ledger["slots"]:
+        assert set(rec["stages"]) >= {"state_transition", "unattributed"}
+    cid = h.chain.flight_recorder.mint("test", node="ui-test")
+    status, resp = _get(srv.port, "/lighthouse/ui/flight_recorder")
+    assert status == 200
+    assert cid in {e["corr_id"] for e in resp["data"]["events"]}
+    status, resp = _get(srv.port, f"/lighthouse/ui/flight_recorder?corr_id={cid}")
+    assert status == 200
+    assert {e["corr_id"] for e in resp["data"]["events"]} == {cid}
+
+
+def test_sim_event_log_reproducible_with_observability_excluded():
+    """Wall clocks live only in the observability payload: two same-seed
+    runs produce byte-identical event logs, and no t_wall leaks into one."""
+    from lighthouse_tpu.sim import SimConfig, Simulation
+
+    def run():
+        sim = Simulation(SimConfig(n_nodes=2, n_validators=8, net="local", seed=11))
+        try:
+            sim.run_slots(6)
+        finally:
+            sim.close()
+        return sim.event_log_json(), sim.observability()
+
+    log1, obs1 = run()
+    log2, _ = run()
+    assert log1 == log2
+    assert '"t_wall"' not in log1 and '"t_mono"' not in log1
+    assert len(obs1) == 2
+    for node_obs in obs1:
+        assert node_obs["slot_ledger"]["slots"], node_obs["node"]
+        assert any(
+            "t_wall" in e for e in node_obs["flight_recorder"]["events"]
+        ), node_obs["node"]
+
+
+def test_bench_require_device_exits_nonzero_on_cpu(tmp_path):
+    """`bench.py --require-device` on a CPU-only host must exit nonzero and
+    still print a degraded JSON line with a provenance block."""
+    import os as _os
+    import pathlib
+    import subprocess
+    import sys as _sys
+
+    bench = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    env = dict(_os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    proc = subprocess.run(
+        [_sys.executable, str(bench), "--require-device"],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    assert proc.returncode != 0
+    last = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(last)
+    assert out["degraded"] is True
+    assert "--require-device" in out["error"]
+    assert out["provenance"]["platform"] == "cpu"
+
+
 def test_lockfile_acquire_retries_replaced_inode(tmp_path, monkeypatch):
     """If the file at the path is replaced after flock, the lock sits on an
     orphaned inode and protects nothing — acquire must detect the swap and
