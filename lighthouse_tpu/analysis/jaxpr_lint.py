@@ -22,13 +22,33 @@ machinery as the AST lints:
                    provenance — the docstring bound becomes a theorem every
                    kernel rewrite must re-prove.  Unhandled primitives are
                    findings too (the analysis never silently passes).
+  jaxpr-float-exact  the MXU-readiness analysis: float-dtype values carry
+                   an integer range [lo, hi] plus a PROVEN-exact flag that
+                   holds iff every value (and every reduction partial) fits
+                   the dtype's exact-integer window ±2^mantissa (float32:
+                   2^24, bfloat16: 2^8 — FLOAT_MANTISSA_BITS, single-
+                   sourced with lints).  int→float conversion enters the
+                   domain when the range fits; add/sub/mul/reduce_sum/
+                   dot_general propagate it (a contraction over K
+                   multiplies the product bound by K — the bound that
+                   answers "what limb width is feasible at what contraction
+                   depth"); float→int conversion of a PROVEN-exact value
+                   re-enters the integer interval domain, so mixed graphs
+                   no longer collapse to all-unknown.  Any kernel that
+                   routes integer data through floats WITHOUT such a proof
+                   — window exceeded, or a float of unproven provenance
+                   converted back to int — is a finding with eqn
+                   source_info provenance.
   jaxpr-dtype      64-bit avals (int64/uint64/float64 — WIDE_DTYPE_NAMES,
                    single-sourced with lints.TracePurityChecker so the AST
                    and jaxpr checks cannot drift) and float promotions
                    inside integer-only kernels.  Under the x64 guard
                    (jax_backend/__init__) these cannot appear in a default
                    trace; the rule catches env drift and explicit wide
-                   inputs.
+                   inputs.  Kernels registered with integer_only=False
+                   (the deliberate MXU float paths, e.g. fp.mul_mxu) skip
+                   the float-promotion rule and answer to jaxpr-float-exact
+                   instead.
   jaxpr-structure  host-sync/callback primitives under trace, and long
                    repeated-eqn runs — an unrolled Python loop that should
                    be a lax.scan (XLA compile time tracks inlined op count
@@ -55,7 +75,7 @@ from pathlib import Path
 import numpy as np
 
 from .engine import Finding
-from .lints import WIDE_DTYPE_NAMES
+from .lints import FLOAT_MANTISSA_BITS, WIDE_DTYPE_NAMES
 
 REPO_ROOT = Path(__file__).resolve().parents[2]
 BUDGETS_PATH = REPO_ROOT / "scripts" / "jaxpr_budgets.json"
@@ -80,33 +100,129 @@ HOST_SYNC_PRIMS = frozenset(
 
 @dataclass(frozen=True)
 class Interval:
-    """Inclusive integer bounds for every element of an array (whole-array
-    abstraction: one [lo, hi] per value, exact Python ints so no analysis-
-    side overflow). `None` in the environment means unknown/tainted (floats,
-    unhandled primitives) — tainted values propagate without triggering
-    range findings; the taint source itself is always a finding."""
+    """Inclusive integer bounds for every element of an integer-dtype array
+    (whole-array abstraction: one [lo, hi] per value, exact Python ints so
+    no analysis-side overflow). `None` in the environment means unknown/
+    tainted (unhandled primitives, unproven floats) — tainted values
+    propagate without triggering range findings; the taint source itself
+    is always a finding."""
 
     lo: int
     hi: int
 
 
+@dataclass(frozen=True)
+class FloatInterval:
+    """Abstract value of a FLOAT-dtype array derived from integer data:
+    integer bounds [lo, hi] plus a PROVEN-exact flag.  `exact=True` means
+    every element is an exactly-representable integer equal to the value
+    infinite-precision arithmetic would have produced — which holds while
+    every intermediate (including reduction partials) stays inside the
+    dtype's exact-integer window ±2^mantissa (FLOAT_MANTISSA_BITS).  Once
+    exactness is lost the bounds are approximate (rounding can nudge past
+    them) and the value can never re-enter the proven integer domain."""
+
+    lo: int
+    hi: int
+    exact: bool
+
+
+def _is_float_dtype(dtype) -> bool:
+    dt = np.dtype(dtype)
+    # ml_dtypes extension floats (bfloat16, float8_*) report kind 'V'
+    return dt.kind == "f" or dt.name in FLOAT_MANTISSA_BITS
+
+
+def float_exact_window(dtype) -> int | None:
+    """W such that every integer in [-W, W] is exactly representable in
+    `dtype` AND integer add/mul results remain exact while they stay within
+    [-W, W].  W = 2^mantissa (implicit bit included); None for non-floats
+    and exotic floats we have no table entry for."""
+    bits = FLOAT_MANTISSA_BITS.get(np.dtype(dtype).name)
+    return None if bits is None else 1 << bits
+
+
+def max_exact_limb_width(dtype="float32", total_bits=384) -> int:
+    """The analyzer's MXU feasibility bound: the widest limb width w such
+    that a full schoolbook contraction over K = ceil(total_bits / w) limb
+    products stays inside `dtype`'s exact-integer window:
+
+        K * (2^w - 1)^2  <=  2^mantissa(dtype)
+
+    This is the limb-width-vs-contraction-depth trade a dot_general-shaped
+    bigint multiplier must respect (ROADMAP item 5); fp.MXU_LIMB_BITS is
+    chosen against this bound and tests pin the two together.  Returns 0
+    when NO width is feasible (e.g. bfloat16's 2^8 window cannot hold even
+    one 384-bit schoolbook column)."""
+    window = float_exact_window(dtype)
+    if window is None:
+        return 0
+    best = 0
+    for w in range(1, total_bits + 1):
+        k = -(-total_bits // w)  # ceil
+        if k * ((1 << w) - 1) ** 2 <= window:
+            best = w
+    return best
+
+
+def limb_feasibility_table(dtype="float32", total_bits=384, widths=range(6, 13)):
+    """Worked feasibility rows for documentation/tests: for each limb width
+    w, the contraction depth K = ceil(total_bits/w), the worst-case column
+    bound K*(2^w-1)^2, the dtype's exact window, and whether the bound
+    fits.  ARCHITECTURE.md's MXU-readiness table is generated from this."""
+    window = float_exact_window(dtype) or 0
+    rows = []
+    for w in widths:
+        k = -(-total_bits // w)
+        bound = k * ((1 << w) - 1) ** 2
+        rows.append(
+            {
+                "width": w,
+                "depth": k,
+                "bound": bound,
+                "window": window,
+                "feasible": bound <= window,
+            }
+        )
+    return rows
+
+
 def _join(a, b):
     if a is None or b is None:
         return None
+    if isinstance(a, FloatInterval) or isinstance(b, FloatInterval):
+        if not (isinstance(a, FloatInterval) and isinstance(b, FloatInterval)):
+            return None  # mixed domains cannot meet (dtype mismatch)
+        return FloatInterval(
+            min(a.lo, b.lo), max(a.hi, b.hi), a.exact and b.exact
+        )
     return Interval(min(a.lo, b.lo), max(a.hi, b.hi))
 
 
-def _widen(iv: Interval) -> Interval:
+def _widen(iv):
     """Power-of-two envelope: guarantees fixpoint termination in a few
-    iterations while staying far tighter than dtype bounds."""
+    iterations while staying far tighter than dtype bounds.  Type- and
+    exactness-preserving (widening only loosens bounds over the same value
+    set, so a carried exact flag stays sound; the next fixpoint iteration
+    re-checks the window against the widened bounds)."""
     hi = (1 << max(1, int(iv.hi).bit_length())) - 1 if iv.hi > 0 else iv.hi
     lo = -(1 << max(1, int(-iv.lo).bit_length())) if iv.lo < 0 else iv.lo
+    if isinstance(iv, FloatInterval):
+        return FloatInterval(lo, hi, iv.exact)
     return Interval(lo, hi)
 
 
-def _const_interval(val) -> Interval | None:
+def _const_interval(val):
     arr = np.asarray(val)
-    if arr.dtype.kind == "f":
+    if _is_float_dtype(arr.dtype):
+        if arr.size == 0:
+            return FloatInterval(0, 0, True)
+        vals = np.asarray(arr, np.float64)
+        if not (np.isfinite(vals).all() and (vals == np.round(vals)).all()):
+            return None  # genuinely fractional / non-finite float data
+        # a literal is its own intention: exactly the integers it holds
+        return FloatInterval(int(vals.min()), int(vals.max()), True)
+    if arr.dtype.kind not in "biu":
         return None
     if arr.size == 0:
         return Interval(0, 0)
@@ -260,10 +376,147 @@ def _reduced_count(eqn) -> int:
     return max(1, n)
 
 
+def _contraction_depth(eqn) -> int:
+    """Number of elements contracted into one output element by dot_general
+    (K in the limb-width feasibility bound K * (2^w - 1)^2 <= 2^mantissa)."""
+    (lhs_c, _rhs_c), _ = eqn.params["dimension_numbers"]
+    n = 1
+    for ax in lhs_c:
+        n *= int(eqn.invars[0].aval.shape[ax])
+    return max(1, n)
+
+
+#: float-dtype primitives INSIDE the exact-integer closure: when every
+#: operand is a proven-exact integer and every result (including reduction
+#: partials — bounded by the corner bounds, see _float_arith_transfer)
+#: stays inside the dtype's ±2^mantissa window, the float result is
+#: bit-exact integer arithmetic.  Anything else (div, sqrt, exp, ...)
+#: leaves the exact domain unconditionally.
+_FLOAT_EXACT_OPS = frozenset(
+    {
+        "add", "sub", "mul", "neg", "abs", "sign", "max", "min",
+        "reduce_sum", "reduce_prod", "integer_pow", "dot_general",
+    }
+)
+
+
+def _float_arith_transfer(name, eqn, ins, ctx) -> list:
+    """Arithmetic transfer for float-dtype outputs: integer corner math on
+    the bounds plus the exactness judgment.  Exactness is LOST (a
+    jaxpr-float-exact finding, once, at the losing eqn) when exact operands
+    produce a range outside the dtype's exact-integer window; values of
+    already-unproven provenance stay unproven silently — their proof
+    failure was reported where it happened, or surfaces at the float→int
+    conversion that tries to use them."""
+    nouts = len(eqn.outvars)
+    if name in ("floor", "ceil", "round", "round_nearest_even"):
+        return [ins[0] if ins else None]  # identity on exact integers
+    if name not in _FLOAT_EXACT_OPS or any(x is None for x in ins):
+        return [None] * nouts
+    exact_in = all(x.exact for x in ins if isinstance(x, FloatInterval))
+    raw = _int_arith(name, eqn, [Interval(x.lo, x.hi) for x in ins])
+    if raw is None:
+        return [None] * nouts
+    dt = np.dtype(eqn.outvars[0].aval.dtype)
+    window = float_exact_window(dt)
+    mant = FLOAT_MANTISSA_BITS.get(dt.name)
+    outs = []
+    for iv in raw:
+        if iv is None:
+            outs.append(None)
+            continue
+        mag = max(abs(iv.lo), abs(iv.hi))
+        # the single corner-bound check also covers every accumulation
+        # partial: same-sign terms only grow toward the corner, mixed
+        # signs only shrink, so partial sums/products of values in
+        # [lo, hi] are bounded by the final corner bounds
+        if exact_in and window is not None and mag <= window:
+            outs.append(FloatInterval(iv.lo, iv.hi, True))
+            continue
+        if exact_in:
+            if name == "dot_general":
+                detail = (
+                    f" (contraction depth {_contraction_depth(eqn)} "
+                    f"multiplies the product bound)"
+                )
+            elif name in ("reduce_sum", "reduce_prod"):
+                detail = f" (reduces {_reduced_count(eqn)} elements per output)"
+            else:
+                detail = ""
+            ctx.finding(
+                "jaxpr-float-exact",
+                eqn,
+                f"float exactness LOST at '{name}': exact integer operands "
+                f"yield result range [{iv.lo}, {iv.hi}], outside the "
+                f"±2^{mant} exact-integer window of {dt.name}{detail} — "
+                f"values round silently on the MXU/VPU; shrink the limb "
+                f"width or contraction depth "
+                f"(analysis/jaxpr_lint.max_exact_limb_width gives the "
+                f"feasibility bound)",
+            )
+        outs.append(FloatInterval(iv.lo, iv.hi, False))
+    return outs
+
+
+def _convert_transfer(eqn, a, ctx):
+    """convert_element_type: the gateway between the integer and float
+    domains.  int→float enters the exact domain iff the proven range fits
+    the window; float→int of a PROVEN-exact value re-enters the integer
+    interval domain (mixed graphs keep their proofs); anything else is the
+    exact failure mode this analysis exists for and is reported."""
+    out_dt = np.dtype(eqn.outvars[0].aval.dtype)
+    in_dt = np.dtype(eqn.invars[0].aval.dtype)
+    if out_dt.kind == "b":
+        return Interval(0, 1) if a is not None else None
+    out_f, in_f = _is_float_dtype(out_dt), _is_float_dtype(in_dt)
+    if a is None:
+        if in_f and not out_f:
+            ctx.finding(
+                "jaxpr-float-exact",
+                eqn,
+                f"float value of unproven provenance converted to "
+                f"{out_dt.name}: integer data was routed through floats "
+                f"without an exactness proof — enter the float segment via "
+                f"an in-window int→float conversion of proven-range data, "
+                f"or keep the computation integer",
+            )
+        return None
+    if not in_f and not out_f:
+        return a  # int→int: the dtype-bounds check in _interp judges it
+    if out_f:
+        window = float_exact_window(out_dt)
+        mant = FLOAT_MANTISSA_BITS.get(out_dt.name)
+        exact_in = a.exact if isinstance(a, FloatInterval) else True
+        mag = max(abs(a.lo), abs(a.hi))
+        if exact_in and window is not None and mag <= window:
+            return FloatInterval(a.lo, a.hi, True)
+        if exact_in:
+            ctx.finding(
+                "jaxpr-float-exact",
+                eqn,
+                f"integer range [{a.lo}, {a.hi}] does not fit the "
+                f"±2^{mant} exact-integer window of {out_dt.name}: values "
+                f"round on conversion and the interval proof is lost — "
+                f"narrow the range (smaller limbs) or use a wider float",
+            )
+        return FloatInterval(a.lo, a.hi, False)
+    # float → int
+    if isinstance(a, FloatInterval) and a.exact:
+        return Interval(a.lo, a.hi)  # proven round-trip re-enters the integer domain
+    ctx.finding(
+        "jaxpr-float-exact",
+        eqn,
+        f"float value converted to {out_dt.name} WITHOUT an exactness "
+        f"proof (bounds [{a.lo}, {a.hi}] are approximate: rounding may "
+        f"have occurred upstream): the integer result is untrusted",
+    )
+    return None
+
+
 def _transfer(eqn, ins, ctx) -> list:
-    """Per-primitive interval transfer. Returns one Interval/None per
-    outvar. Pure integer math on Python ints — the analysis itself cannot
-    overflow."""
+    """Per-primitive interval transfer. Returns one Interval/FloatInterval/
+    None per outvar. Pure integer math on Python ints — the analysis itself
+    cannot overflow."""
     name = eqn.primitive.name
     a = ins[0] if ins else None
     b = ins[1] if len(ins) > 1 else None
@@ -272,19 +525,52 @@ def _transfer(eqn, ins, ctx) -> list:
         # already a jaxpr-structure finding; don't double-report as unhandled
         return [None] * len(eqn.outvars)
 
-    # structural pass-throughs (value set preserved or shrunk)
+    if name == "convert_element_type":
+        return [_convert_transfer(eqn, a, ctx)]
+
+    # structural pass-throughs (value set preserved or shrunk) — domain-
+    # agnostic: a FloatInterval rides through with its exactness intact
     if name in (
         "broadcast_in_dim", "reshape", "transpose", "squeeze", "rev", "copy",
-        "device_put", "stop_gradient", "slice", "gather", "real", "expand_dims",
-        "reduce_max", "reduce_min", "reduce_precision", "convert_element_type",
-        "optimization_barrier",
+        "device_put", "stop_gradient", "slice", "real", "expand_dims",
+        "reduce_max", "reduce_min", "optimization_barrier",
     ):
-        if name == "convert_element_type":
-            new = eqn.params.get("new_dtype")
-            if new is not None and np.dtype(new).kind == "b":
-                return [Interval(0, 1) if a is not None else None]
         if name == "optimization_barrier":
             return list(ins)
+        return [a]
+    if name == "gather":
+        # Integer gathers keep the historical in-bounds assumption (table
+        # lookups whose index arithmetic the whole-array interval cannot
+        # separate from its selector).  FLOAT gathers must not: FILL mode
+        # injects NaN into out-of-bounds lanes, which would silently ride
+        # an exactness proof — join the fill value (NaN taints) unless the
+        # index interval proves every lane in bounds.  fp.mul_mxu uses
+        # mode="clip" precisely so this stays precise.
+        fv = eqn.params.get("fill_value")
+        if fv is not None and _is_float_dtype(eqn.outvars[0].aval.dtype):
+            in_bounds = False
+            if b is not None:
+                dnums = eqn.params["dimension_numbers"]
+                sizes = eqn.params["slice_sizes"]
+                shape = eqn.invars[0].aval.shape
+                lim = min(
+                    (int(shape[d]) - int(sizes[d]) for d in dnums.start_index_map),
+                    default=0,
+                )
+                in_bounds = 0 <= b.lo and b.hi <= lim
+            if not in_bounds:
+                fill = np.asarray(fv, dtype=eqn.outvars[0].aval.dtype)
+                return [_join(a, _const_interval(fill))]
+        return [a]
+    if name == "reduce_precision":
+        if isinstance(a, FloatInterval):
+            mbits = eqn.params.get("mantissa_bits")
+            ok = (
+                a.exact
+                and mbits is not None
+                and max(abs(a.lo), abs(a.hi)) <= (1 << int(mbits))
+            )
+            return [FloatInterval(a.lo, a.hi, bool(ok))]
         return [a]
     if name in ("dynamic_slice",):
         return [a]
@@ -303,7 +589,13 @@ def _transfer(eqn, ins, ctx) -> list:
         if a is None or ins[2] is None:
             return [None]
         upd = ins[2]
-        return [Interval(a.lo + min(0, upd.lo), a.hi + max(0, upd.hi))]
+        out = Interval(a.lo + min(0, upd.lo), a.hi + max(0, upd.hi))
+        if isinstance(a, FloatInterval) or isinstance(upd, FloatInterval):
+            exact = all(
+                x.exact for x in (a, upd) if isinstance(x, FloatInterval)
+            )
+            return [FloatInterval(out.lo, out.hi, exact)]
+        return [out]
     if name == "select_n":
         out = ins[1]
         for x in ins[2:]:
@@ -313,7 +605,13 @@ def _transfer(eqn, ins, ctx) -> list:
         lo_i, x, hi_i = ins
         if lo_i is None or x is None or hi_i is None:
             return [None]
-        return [Interval(max(lo_i.lo, min(x.lo, hi_i.hi)), min(hi_i.hi, max(x.hi, lo_i.lo)))]
+        out = Interval(
+            max(lo_i.lo, min(x.lo, hi_i.hi)), min(hi_i.hi, max(x.hi, lo_i.lo))
+        )
+        if any(isinstance(v, FloatInterval) for v in ins):
+            exact = all(v.exact for v in ins if isinstance(v, FloatInterval))
+            return [FloatInterval(out.lo, out.hi, exact)]
+        return [out]
     if name == "iota":
         dim = eqn.params.get("dimension", 0)
         shape = eqn.params.get("shape", (1,))
@@ -351,9 +649,32 @@ def _transfer(eqn, ins, ctx) -> list:
             outs = res if outs is None else [_join(x, y) for x, y in zip(outs, res)]
         return outs
 
-    # arithmetic
+    # float-dtype arithmetic: the exact-integer closure keeps the proof
+    # alive; everything else leaves the value unproven (never a silent
+    # integer-domain pass)
+    if eqn.outvars and _is_float_dtype(eqn.outvars[0].aval.dtype):
+        return _float_arith_transfer(name, eqn, ins, ctx)
+
+    # integer arithmetic: proven-exact float operands collapse to their
+    # integer bounds (comparisons/selects over them are real integer facts),
+    # unproven floats taint
+    ins = [
+        Interval(x.lo, x.hi)
+        if isinstance(x, FloatInterval) and x.exact
+        else (None if isinstance(x, FloatInterval) else x)
+        for x in ins
+    ]
     if any(x is None for x in ins) and name not in ("and", "or", "xor", "not"):
         return [None] * len(eqn.outvars)
+    return _int_arith(name, eqn, ins)
+
+
+def _int_arith(name, eqn, ins):
+    """Integer corner math shared by the integer and float-exact domains.
+    Returns a list of Interval/None per outvar, or None for an unhandled
+    primitive."""
+    a = ins[0] if ins else None
+    b = ins[1] if len(ins) > 1 else None
     if name == "add":
         return [Interval(a.lo + b.lo, a.hi + b.hi)]
     if name == "sub":
@@ -494,6 +815,25 @@ def _while_transfer(eqn, ins, ctx):
     return carry
 
 
+def _coerce_domain(var, iv):
+    """Align an abstract value with its variable's dtype.  Structural and
+    generator ops that produced a plain Interval for a float output (iota,
+    and constants folded through selects) gain the exactness judgment —
+    their values ARE integers, so exact iff in-window.  A FloatInterval
+    reaching an integer variable (only possible through value-preserving
+    structure) collapses to its bounds when proven, taints otherwise."""
+    if iv is None:
+        return None
+    isf = _is_float_dtype(var.aval.dtype)
+    if isf and type(iv) is Interval:
+        w = float_exact_window(var.aval.dtype)
+        exact = w is not None and max(abs(iv.lo), abs(iv.hi)) <= w
+        return FloatInterval(iv.lo, iv.hi, exact)
+    if not isf and isinstance(iv, FloatInterval):
+        return Interval(iv.lo, iv.hi) if iv.exact else None
+    return iv
+
+
 def _interp(jaxpr, consts, in_ivals, ctx) -> list:
     """Interpret one jaxpr level over intervals, checking every integer
     output against its dtype bounds."""
@@ -505,27 +845,25 @@ def _interp(jaxpr, consts, in_ivals, ctx) -> list:
         return env.get(atom)
 
     for var, const in zip(jaxpr.constvars, consts):
-        env[var] = _const_interval(const)
+        env[var] = _coerce_domain(var, _const_interval(const))
     for var, iv in zip(jaxpr.invars, in_ivals):
-        env[var] = iv
+        env[var] = _coerce_domain(var, iv)
 
     for eqn in jaxpr.eqns:
         ins = [read(x) for x in eqn.invars]
         outs = _transfer(eqn, ins, ctx)
         if outs is None:
-            if all(np.dtype(v.aval.dtype).kind == "f" for v in eqn.outvars):
-                outs = [None] * len(eqn.outvars)  # float graph: dtype lint owns it
-            else:
-                ctx.finding(
-                    "jaxpr-interval",
-                    eqn,
-                    f"unhandled primitive '{eqn.primitive.name}': interval "
-                    f"analysis cannot bound its output — extend "
-                    f"analysis/jaxpr_lint._transfer",
-                )
-                outs = [None] * len(eqn.outvars)
+            ctx.finding(
+                "jaxpr-interval",
+                eqn,
+                f"unhandled primitive '{eqn.primitive.name}': interval "
+                f"analysis cannot bound its output — extend "
+                f"analysis/jaxpr_lint._transfer",
+            )
+            outs = [None] * len(eqn.outvars)
         for var, iv in zip(eqn.outvars, outs):
-            if iv is not None:
+            iv = _coerce_domain(var, iv)
+            if type(iv) is Interval:
                 bounds = _dtype_bounds(var.aval.dtype)
                 if bounds is not None:
                     lo, hi = bounds
@@ -570,7 +908,7 @@ def _dtype_findings(closed, spec, ctx) -> None:
                         f"fast 64-bit path; see jax_backend/__init__ x64 "
                         f"guard)",
                     )
-                elif dt.kind == "f" and spec.integer_only:
+                elif _is_float_dtype(dt) and spec.integer_only:
                     ctx.finding(
                         "jaxpr-dtype",
                         eqn,
@@ -751,31 +1089,59 @@ def analyze_closed(closed, seeds, spec) -> list[Finding]:
     ctx = _Ctx(spec)
     _dtype_findings(closed, spec, ctx)
     _structure_findings(closed, ctx)
-    _interp(closed.jaxpr, list(closed.consts), seeds, ctx)
+    ivals = [
+        _coerce_domain(var, iv)
+        for var, iv in zip(closed.jaxpr.invars, seeds)
+    ]
+    _interp(closed.jaxpr, list(closed.consts), ivals, ctx)
     return ctx.findings
 
 
 def analyze_kernels(
-    tiers=("fast",), kernels=None, budgets=None
+    tiers=("fast",), kernels=None, budgets=None, only=None,
+    require_float_path=False,
 ) -> tuple[list[Finding], dict]:
     """Trace + analyze registered kernels; returns (findings, counts).
 
     tiers: registry tiers to include ("fast" is the tier-1 gate; add
     "slow" for the full composite kernels). kernels: optional explicit
     name filter. budgets: baseline dict (load_budgets()) to gate against,
-    or None to skip the budget comparison (e.g. while refreshing)."""
+    or None to skip the budget comparison (e.g. while refreshing).
+    only: substring filter over kernel names (scripts/lint.py --only —
+    the big slow-tier composites take minutes each to trace, so
+    all-or-nothing is not a workable CLI). require_float_path: emit a
+    finding when the selection contains no integer_only=False kernel,
+    so the jaxpr-float-exact gate can never pass vacuously (mirrors the
+    >=15-kernel guard in tests/test_jaxpr_lint.py)."""
     from ..crypto.bls.jax_backend import registry
 
     specs = registry.kernel_specs(tiers=tiers)
     if kernels is not None:
         wanted = set(kernels)
         specs = [s for s in specs if s.name in wanted]
+    if only:
+        specs = [s for s in specs if only in s.name]
     findings: list[Finding] = []
     counts: dict = {}
     for spec in specs:
         closed, seeds = trace_kernel(spec)
         counts[spec.name] = count_primitives(closed)
         findings.extend(analyze_closed(closed, seeds, spec))
+    if require_float_path and not any(not s.integer_only for s in specs):
+        findings.append(
+            Finding(
+                rule="jaxpr-float-exact",
+                path="lighthouse_tpu/crypto/bls/jax_backend/registry.py",
+                line=0,
+                symbol="<registry>",
+                message=(
+                    "vacuous float-exactness gate: no float-path kernel "
+                    "(integer_only=False, e.g. fp.mul_mxu) was traced in "
+                    "this selection — register one or widen the "
+                    "tier/filter selection"
+                ),
+            )
+        )
     if budgets is not None:
         findings.extend(budget_findings(counts, budgets, registry.kernel_names()))
     findings.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))

@@ -450,6 +450,21 @@ IMPURE_MODULE_CALLS = {"time", "random", "secrets"}
 #: hot path; jax_backend/__init__ guards jax_enable_x64 at import).
 WIDE_DTYPE_NAMES = frozenset({"int64", "uint64", "float64"})
 
+#: Mantissa widths (implicit bit included) of the float dtypes a TPU kernel
+#: can plausibly route integer data through.  Integer add/mul on a float
+#: lane is EXACT while every value (including reduction partials) stays
+#: within ±2^mantissa — beyond that window results round silently, which
+#: for limb arithmetic is the same forgery-grade bug as an int32 wrap.
+#: Single source of truth for the jaxpr float-exactness analysis
+#: (analysis/jaxpr_lint.py imports this), mirroring WIDE_DTYPE_NAMES so
+#: the dtype taxonomy cannot drift between the AST and jaxpr layers.
+FLOAT_MANTISSA_BITS = {
+    "bfloat16": 8,
+    "float16": 11,
+    "float32": 24,
+    "float64": 53,
+}
+
 #: module roots whose 64-bit dtype attributes we flag inside traced code
 _DTYPE_MODULE_ROOTS = {"np", "numpy", "jnp", "jax"}
 

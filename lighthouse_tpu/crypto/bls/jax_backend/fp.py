@@ -309,11 +309,102 @@ def neg(a: jnp.ndarray) -> jnp.ndarray:
 
 def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """Montgomery product: a * b * 2^-384 mod p, canonical output."""
+    if USE_MXU_MUL:
+        return mul_mxu(a, b)
     return redc(poly(a, b), mult=2)
 
 
 def sqr(a: jnp.ndarray) -> jnp.ndarray:
     return mul(a, a)
+
+
+# -- MXU path: limb product as a float32 dot_general ---------------------------
+#
+# ROADMAP item 5 wants batches of Fp muls fed to the MXU, whose native
+# accumulation is float32.  That is only sound while every value the matmul
+# produces is an exactly-representable integer — which is a *limb-width*
+# question: contracting K limb products of w-bit limbs bounds each output
+# column by K * (2^w - 1)^2, and float32 is exact up to 2^24.  The limb
+# width below is therefore DERIVED from the analyzer's feasibility bound
+# (analysis/jaxpr_lint.max_exact_limb_width, = 9 for float32/384 bits), not
+# chosen by hand; `scripts/lint.py --jaxpr` re-proves the whole trace exact
+# on every run (rule jaxpr-float-exact, empty allowlist).
+#
+# This is the correctness-only reference shape: narrow limbs for the
+# product, immediate recombination back into the canonical 12-bit column
+# domain, and the ordinary redc.  The perf experiment (tiling, staying in
+# the byte domain across tower ops, bfloat16 split-limbs — infeasible
+# as-is: max_exact_limb_width("bfloat16") == 0) builds on it.
+
+from lighthouse_tpu.analysis.jaxpr_lint import max_exact_limb_width
+
+_MXU_FEASIBLE_BITS = max_exact_limb_width("float32", BITS)  # widest sound width (9)
+#: widest feasible width that also divides 2*LIMB_BITS, so exactly two
+#: 12-bit limbs make three MXU limbs and the repack is a fixed shuffle
+MXU_LIMB_BITS = max(
+    w for w in range(1, _MXU_FEASIBLE_BITS + 1) if (2 * LIMB_BITS) % w == 0
+)
+assert MXU_LIMB_BITS == 8, "repack below assumes byte limbs"
+MXU_N_LIMBS = BITS // MXU_LIMB_BITS  # 48
+MXU_LIMB_MASK = (1 << MXU_LIMB_BITS) - 1
+
+# Banded convolution-matrix layout, host-precomputed: column k of the byte
+# product is sum_i a_i * b_{k-i}, i.e. a (48,) limb vector times a (48, 95)
+# band matrix whose row i is b shifted right by i.
+_BAND_DIFF = np.arange(2 * MXU_N_LIMBS - 1)[None, :] - np.arange(MXU_N_LIMBS)[:, None]
+_BAND_VALID = (_BAND_DIFF >= 0) & (_BAND_DIFF < MXU_N_LIMBS)
+# clip (NOT fill) out-of-band indices: a fill value would be a NaN/garbage
+# lane the exactness proof cannot admit; clipped lanes are masked to 0.0
+_BAND_IDX = np.clip(_BAND_DIFF, 0, MXU_N_LIMBS - 1).astype(np.int32)
+
+
+def _to_byte_limbs(a: jnp.ndarray) -> jnp.ndarray:
+    """(..., 32) 12-bit limbs -> (..., 48) 8-bit limbs, same value.  Each
+    little-endian limb pair (l0, l1) = 24 bits = bytes (l0 & 0xFF,
+    l0 >> 8 | (l1 & 0xF) << 4, l1 >> 4)."""
+    pair = a.reshape(a.shape[:-1] + (N_LIMBS // 2, 2))
+    l0, l1 = pair[..., 0], pair[..., 1]
+    b = jnp.stack(
+        [l0 & MXU_LIMB_MASK, (l0 >> 8) | ((l1 & 0xF) << 4), l1 >> 4], axis=-1
+    )
+    return b.reshape(a.shape[:-1] + (MXU_N_LIMBS,))
+
+
+def mul_mxu(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Montgomery product routed through a float32 dot_general (MXU shape).
+
+    Byte-limb schoolbook columns via limb-vector x banded matrix: every
+    float value is an integer <= 48 * 255^2 = 3,121,200 < 2^24, so the
+    matmul is bit-exact (proven by jaxpr-float-exact on every lint run,
+    not just asserted here).  The 95 byte columns recombine into the
+    canonical 63/64-column 12-bit domain — column 3t+1 re-weights by 2^8
+    onto even column 2t and column 3t+2 by 2^4 onto odd column 2t+1, with
+    bounds 3,121,200 * 257 < 2^30 and * 16 < 2^26, inside redc's column
+    contract — and the ordinary redc finishes, so the output is canonical
+    and byte-identical to mul()."""
+    af = _to_byte_limbs(a).astype(jnp.float32)
+    bf = _to_byte_limbs(b).astype(jnp.float32)
+    band = jnp.where(
+        jnp.asarray(_BAND_VALID),
+        jnp.take(bf, jnp.asarray(_BAND_IDX), axis=-1, mode="clip"),
+        jnp.float32(0.0),
+    )
+    cols8 = jnp.einsum("...i,...ik->...k", af, band)  # (..., 95) float32, exact
+    c8 = cols8.astype(jnp.int32)
+    c8 = jnp.pad(c8, [(0, 0)] * (c8.ndim - 1) + [(0, 1)])  # (..., 96)
+    trip = c8.reshape(c8.shape[:-1] + (N_LIMBS, 3))
+    even = trip[..., 0] + (trip[..., 1] << 8)
+    odd = trip[..., 2] << 4
+    cols12 = jnp.stack([even, odd], axis=-1).reshape(c8.shape[:-1] + (2 * N_LIMBS,))
+    return redc(cols12, mult=2)
+
+
+#: route mul() through the MXU shape (correctness-only reference; perf is
+#: ROADMAP item 5's experiment).  Read once at import so traced graphs
+#: never consult the environment (trace-purity lint).
+import os as _os
+
+USE_MXU_MUL = _os.environ.get("LIGHTHOUSE_TPU_MXU_FP_MUL", "") == "1"
 
 
 POW_WINDOW = 4
@@ -474,6 +565,23 @@ def _spec_neg():
 def _spec_mul():
     a = _limb_vec()
     return mul, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("fp.mul_mxu", integer_only=False)
+def _spec_mul_mxu():
+    # float-path kernel: jaxpr-float-exact must PROVE the float32
+    # dot_general exact from the LIMB precondition (the fast tier keeps
+    # the gate non-vacuous — see analyze_kernels(require_float_path=True))
+    a = _limb_vec()
+    return mul_mxu, (a, a), [_reg.LIMB, _reg.LIMB]
+
+
+@_reg.register("fp.mul_mxu@B64", tier="slow", integer_only=False)
+def _spec_mul_mxu_b64():
+    # batched MXU shape (the form ROADMAP item 5 actually dispatches):
+    # same proof obligations over a (64, 32) batch
+    a = np.zeros((64, N_LIMBS), np.int32)
+    return mul_mxu, (a, a), [_reg.LIMB, _reg.LIMB]
 
 
 @_reg.register("fp.mont_reduce")

@@ -29,7 +29,16 @@ Python and scales with inlined eqn count):
            by `scripts/lint.py --jaxpr --all-tiers` and the @slow test.
 
 Budgets (scripts/jaxpr_budgets.json) cover BOTH tiers; refresh with
-`python scripts/lint.py --update-budgets`.
+`python scripts/lint.py --update-budgets` (add `--only SUBSTR` to refresh
+a subset without re-tracing the big composites).
+
+`integer_only=False` marks a kernel as a DELIBERATE float path (e.g.
+fp.mul_mxu routing limb products through a float32 dot_general for the
+MXU): the jaxpr-dtype float-promotion rule is skipped, and correctness is
+instead owed to the jaxpr-float-exact analysis, which must PROVE every
+float value an exactly-representable integer from these same seeds.  The
+gate is non-vacuous — `analyze_kernels(require_float_path=True)` fails if
+no integer_only=False kernel is in the selection.
 
 New kernels (including sharded ones — ROADMAP item 2 registers shard_map
 bodies the same way) get analyzed by adding one `@register` hook; the

@@ -20,6 +20,15 @@
                                               # of scripts/jaxpr_budgets.json
                                               # is the explanation reviewers
                                               # see)
+    python scripts/lint.py --jaxpr --only fp.mul   # trace/analyze only the
+                                              # kernels whose name contains
+                                              # the substring (both tiers —
+                                              # slow composites are ~150 s
+                                              # each, all-or-nothing is not
+                                              # workable); --json works too.
+                                              # With --update-budgets, only
+                                              # the matching entries are
+                                              # rewritten (merge, not wipe)
 
 Allowlist: scripts/lint_allowlist.txt — one `rule:path:symbol` per line,
 each with a mandatory `  # one-line justification`. Unjustified or stale
@@ -54,7 +63,7 @@ DEFAULT_PATHS = ["lighthouse_tpu", "scripts"]
 ALLOWLIST = REPO_ROOT / "scripts" / "lint_allowlist.txt"
 
 
-def _jaxpr_findings(all_tiers: bool, update_budgets: bool):
+def _jaxpr_findings(all_tiers: bool, update_budgets: bool, only: str | None):
     """Deferred import: jax only loads under --jaxpr/--update-budgets."""
     import os
 
@@ -65,17 +74,54 @@ def _jaxpr_findings(all_tiers: bool, update_budgets: bool):
 
     from lighthouse_tpu.analysis import jaxpr_lint
 
-    tiers = ("fast", "slow") if (all_tiers or update_budgets) else ("fast",)
+    tiers = (
+        ("fast", "slow")
+        if (all_tiers or update_budgets or only)
+        else ("fast",)
+    )
     budgets = None if update_budgets else jaxpr_lint.load_budgets()
-    findings, counts = jaxpr_lint.analyze_kernels(tiers=tiers, budgets=budgets)
+    findings, counts = jaxpr_lint.analyze_kernels(
+        tiers=tiers,
+        budgets=None if only else budgets,
+        only=only,
+        # a filtered selection may legitimately contain no float-path
+        # kernel; the unfiltered gate must never be vacuously green
+        require_float_path=only is None,
+    )
+    if only and not counts:
+        raise LintConfigError(f"--only {only!r} matched no registered kernel")
+    if only and not update_budgets and budgets is not None:
+        # per-kernel budget comparison for just the selection (skip the
+        # registry-staleness sweep, which needs the full kernel set)
+        findings = findings + [
+            f
+            for f in jaxpr_lint.budget_findings(
+                counts, budgets, jaxpr_lint_registry_names()
+            )
+            if f.symbol in counts
+        ]
+        findings.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
     if update_budgets:
-        jaxpr_lint.save_budgets(counts)
+        if only:  # merge: refresh matching entries, keep the rest
+            merged = jaxpr_lint.load_budgets()
+            merged.update(counts)
+            known = set(jaxpr_lint_registry_names())
+            merged = {k: v for k, v in merged.items() if k in known}
+            jaxpr_lint.save_budgets(merged)
+        else:
+            jaxpr_lint.save_budgets(counts)
         print(
             f"wrote {jaxpr_lint.BUDGETS_PATH.relative_to(REPO_ROOT)} "
-            f"({len(counts)} kernels)",
+            f"({len(counts)} kernel(s) refreshed)",
             file=sys.stderr,
         )
     return findings
+
+
+def jaxpr_lint_registry_names():
+    from lighthouse_tpu.crypto.bls.jax_backend import registry
+
+    return registry.kernel_names()
 
 
 def main(argv=None) -> int:
@@ -102,16 +148,29 @@ def main(argv=None) -> int:
         "(implies --jaxpr --all-tiers; skips the budget comparison)",
     )
     ap.add_argument(
+        "--only",
+        metavar="SUBSTR",
+        default=None,
+        help="with --jaxpr/--update-budgets: restrict to kernels whose "
+        "registry name contains SUBSTR (searches both tiers; with "
+        "--update-budgets, merges the refreshed entries into the baseline)",
+    )
+    ap.add_argument(
         "--allowlist", default=str(ALLOWLIST), help="allowlist file (default: %(default)s)"
     )
     args = ap.parse_args(argv)
+
+    if args.only and not (args.jaxpr or args.update_budgets):
+        ap.error("--only requires --jaxpr or --update-budgets")
 
     paths = args.paths or DEFAULT_PATHS
     try:
         entries = load_allowlist(args.allowlist)
         findings = run_lints(paths, default_checkers(), root=REPO_ROOT)
         if args.jaxpr or args.update_budgets:
-            findings = findings + _jaxpr_findings(args.all_tiers, args.update_budgets)
+            findings = findings + _jaxpr_findings(
+                args.all_tiers, args.update_budgets, args.only
+            )
             findings.sort(key=lambda f: (f.path, f.line, f.rule))
         kept, suppressed, stale = apply_allowlist(findings, entries)
     except LintConfigError as e:

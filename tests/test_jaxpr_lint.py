@@ -187,6 +187,160 @@ def test_wide_dtypes_single_sourced_with_ast_lint():
     assert jaxpr_lint.WIDE_DTYPE_NAMES is ast_names
 
 
+# -- the float exact-integer domain (jaxpr-float-exact) ------------------------
+#
+# Fixtures are registered with integer_only=False (deliberate float paths,
+# like fp.mul_mxu): the jaxpr-dtype promotion rule stands down and any
+# finding below is the float-exactness analysis itself speaking.
+
+
+def _f32_roundtrip(x):
+    promoted = x.astype(jnp.float32)
+    return promoted.astype(jnp.int32)
+
+
+def _bf16_roundtrip(x):
+    promoted = x.astype(jnp.bfloat16)
+    return promoted.astype(jnp.int32)
+
+
+def test_float_exact_proves_f32_roundtrip_inside_mantissa_window():
+    """Integers up to 2^24 are exactly representable in float32: the
+    int->float->int round-trip is PROVEN and produces no findings."""
+    x = np.zeros(8, np.int32)
+    findings = analyze_fixture(_f32_roundtrip, (x,), [(0, 1 << 24)], integer_only=False)
+    assert findings == [], [f.format() for f in findings]
+
+
+def test_float_exact_fails_f32_roundtrip_past_mantissa_window():
+    """The SAME graph seeded one past the window (2^24 + 1) must fail, with
+    file:line provenance at both the lossy promotion and the unproven
+    conversion back."""
+    x = np.zeros(8, np.int32)
+    findings = analyze_fixture(
+        _f32_roundtrip, (x,), [(0, (1 << 24) + 1)], integer_only=False
+    )
+    fx = [f for f in findings if f.rule == "jaxpr-float-exact"]
+    assert len(fx) == 2, [f.format() for f in findings]
+    enter, leave = fx
+    assert "does not fit" in enter.message and "2^24" in enter.message
+    assert "WITHOUT an exactness proof" in leave.message
+    assert {enter.path, leave.path} == {THIS_FILE}
+    assert 0 < enter.line < leave.line  # two distinct offending eqns
+
+
+def test_float_exact_bfloat16_window_is_2_to_8():
+    """bfloat16's 8-bit mantissa makes the exact window 2^8 — the analog
+    pair proves/fails at 256/257."""
+    x = np.zeros(8, np.int32)
+    ok = analyze_fixture(_bf16_roundtrip, (x,), [(0, 1 << 8)], integer_only=False)
+    assert ok == [], [f.format() for f in ok]
+    bad = analyze_fixture(_bf16_roundtrip, (x,), [(0, (1 << 8) + 1)], integer_only=False)
+    fx = [f for f in bad if f.rule == "jaxpr-float-exact"]
+    assert fx and "bfloat16" in fx[0].message and "2^8" in fx[0].message
+
+
+def _mxu_contract(a, b):
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    cols = jnp.einsum("i,ik->k", af, bf)
+    return cols.astype(jnp.int32)
+
+
+def test_float_exact_dot_general_bound_scales_with_contraction_depth():
+    """Contracting K byte-limb products bounds each output by
+    K * 255^2: PROVEN at K=48 (fp.mul_mxu's shape, bound 3,121,200 < 2^24),
+    unprovable at K=512 (33,292,800 > 2^24) — the flip that tells ROADMAP
+    item 5 what limb width is feasible at what contraction depth."""
+    byte = (0, 255)
+    a, b = np.zeros(48, np.int32), np.zeros((48, 8), np.int32)
+    ok = analyze_fixture(_mxu_contract, (a, b), [byte, byte], integer_only=False)
+    assert ok == [], [f.format() for f in ok]
+
+    a, b = np.zeros(512, np.int32), np.zeros((512, 8), np.int32)
+    bad = analyze_fixture(_mxu_contract, (a, b), [byte, byte], integer_only=False)
+    fx = [f for f in bad if f.rule == "jaxpr-float-exact"]
+    assert fx, [f.format() for f in bad]
+    assert "float exactness LOST at 'dot_general'" in fx[0].message
+    assert "contraction depth 512" in fx[0].message
+    assert fx[0].path == THIS_FILE and fx[0].line > 0
+
+
+def _mixed_reentry(x, scale):
+    f = x.astype(jnp.float32)
+    doubled = f + f
+    back = doubled.astype(jnp.int32)
+    return back * scale  # integer domain again — bounds must be concrete
+
+
+def test_float_exact_reentry_keeps_integer_subgraph_proven():
+    """A proven-exact float segment converts back to int32 and RE-ENTERS
+    the integer interval domain (the mixed-graph fix): downstream integer
+    math is judged on real bounds, not tainted to silence."""
+    x = np.zeros(8, np.int32)
+    s = np.ones(8, np.int32)
+    seeds = [(0, 1 << 11), (0, 1 << 7)]
+    findings = analyze_fixture(_mixed_reentry, (x, s), seeds, integer_only=False)
+    assert findings == [], [f.format() for f in findings]
+    # ...and the re-entered interval has teeth: scaling the same graph into
+    # int32 overflow is caught IN THE INTEGER DOMAIN, downstream of the
+    # float segment — impossible while mixed graphs collapsed to all-None
+    bad = analyze_fixture(
+        _mixed_reentry, (x, s), [(0, 1 << 11), (0, 1 << 20)], integer_only=False
+    )
+    wraps = [f for f in bad if f.rule == "jaxpr-interval"]
+    assert wraps and "exceeds int32" in wraps[0].message, [f.format() for f in bad]
+
+
+def test_float_exact_flags_fractional_float_into_int():
+    """Genuinely fractional float math feeding an integer conversion is the
+    original failure mode and still fails (now under the float-exact rule
+    rather than by silent taint)."""
+
+    def leak(x):
+        return (x.astype(jnp.float32) * 1.5).astype(jnp.int32)
+
+    findings = analyze_fixture(
+        leak, (np.zeros(8, np.int32),), [LIMB12], integer_only=False
+    )
+    fx = [f for f in findings if f.rule == "jaxpr-float-exact"]
+    assert fx and "without an exactness proof" in fx[0].message.lower(), [
+        f.format() for f in findings
+    ]
+
+
+def test_float_exact_feasibility_bound_picks_fp_mxu_limb_width():
+    """The analyzer's closed-form bound is the authority fp.py derives its
+    MXU limb width from: widest sound width 9 for float32/384-bit, byte
+    alignment picks 8, and bfloat16 admits NO width at all."""
+    from lighthouse_tpu.crypto.bls.jax_backend import fp
+
+    assert jaxpr_lint.max_exact_limb_width("float32", 384) == 9
+    assert jaxpr_lint.max_exact_limb_width("bfloat16", 384) == 0
+    assert fp.MXU_LIMB_BITS == 8 and fp.MXU_N_LIMBS == 48
+    rows = {r["width"]: r for r in jaxpr_lint.limb_feasibility_table("float32", 384)}
+    assert rows[8]["feasible"] and rows[9]["feasible"]
+    assert not rows[10]["feasible"] and not rows[12]["feasible"]
+    assert rows[8]["depth"] == 48 and rows[8]["bound"] == 48 * 255 * 255
+
+
+def test_analyze_kernels_only_filter_and_vacuity_guard():
+    """--only narrows the selection by substring; require_float_path makes
+    a float-path-free selection fail instead of passing vacuously."""
+    findings, counts = jaxpr_lint.analyze_kernels(
+        tiers=("fast",), only="fp.add", require_float_path=True
+    )
+    assert set(counts) == {"fp.add"}
+    vac = [f for f in findings if f.rule == "jaxpr-float-exact"]
+    assert vac and "vacuous" in vac[0].message, [f.format() for f in findings]
+
+    findings, counts = jaxpr_lint.analyze_kernels(
+        tiers=("fast",), only="fp.mul_mxu", require_float_path=True
+    )
+    assert set(counts) == {"fp.mul_mxu"}
+    assert findings == [], [f.format() for f in findings]
+
+
 # -- budgets -------------------------------------------------------------------
 
 
@@ -255,13 +409,19 @@ def test_fast_tier_kernels_proven_overflow_free_within_budget():
     batch-affine) lands against."""
     budgets = jaxpr_lint.load_budgets()
     assert budgets, "scripts/jaxpr_budgets.json missing — run --update-budgets"
-    findings, counts = jaxpr_lint.analyze_kernels(tiers=("fast",), budgets=budgets)
+    findings, counts = jaxpr_lint.analyze_kernels(
+        tiers=("fast",), budgets=budgets, require_float_path=True
+    )
     assert not findings, "\n".join(f.format() for f in findings)
     # the registry actually covered the kernel surface (guards accidental
     # registry emptiness making this gate vacuous)
     assert len(counts) >= 15
     for family in ("fp.", "tower.", "curve.", "pairing.", "h2c."):
         assert any(k.startswith(family) for k in counts), family
+    # ...including the float-path kernel the jaxpr-float-exact analysis
+    # exists for: zero findings above means its float32 dot_general is
+    # PROVEN exact, not skipped
+    assert "fp.mul_mxu" in counts
 
 
 @pytest.mark.slow

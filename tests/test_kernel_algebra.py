@@ -76,6 +76,54 @@ def test_kernel_opcount_ratchet():
         assert after < before, f"{name}: {after} eqns, pre-rewrite {before}"
 
 
+# -- MXU-path Fp multiplication ------------------------------------------------
+
+
+@jax.jit
+def _mul_mxu_drive(a, b):
+    return fp.mul(a, b), fp.mul_mxu(a, b)
+
+
+def test_mul_mxu_byte_identical_on_edge_inputs():
+    """The float32 dot_general multiplier matches the VPU schoolbook mul
+    byte-for-byte on the algebraic edges (0, 1, 2, p-1, p-2 in Montgomery
+    form) and random elements — the correctness half of ROADMAP item 5,
+    whose exactness the jaxpr-float-exact analysis proves statically."""
+    xs = [0, 1, 2, P - 1, P - 2, rng.randrange(P), rng.randrange(P), 0]
+    ys = [P - 1, 0, 1, P - 2, 2, rng.randrange(P), 1, 0]
+    a = jnp.asarray(np.stack([fp.to_mont_host(x) for x in xs]))
+    b = jnp.asarray(np.stack([fp.to_mont_host(y) for y in ys]))
+    ref, got = (np.asarray(v) for v in _mul_mxu_drive(a, b))
+    assert np.array_equal(ref, got)
+    for i, (x, y) in enumerate(zip(xs, ys)):
+        assert fp.from_mont_host(got[i]) == (x * y) % P, f"lane {i}"
+
+
+def test_mul_mxu_flag_reroutes_mul_through_dot_general(monkeypatch):
+    """LIGHTHOUSE_TPU_MXU_FP_MUL=1 (read once at import into USE_MXU_MUL,
+    never from traced code) reroutes fp.mul onto the MXU shape — visible
+    in the trace as a dot_general, absent by default."""
+    a = np.zeros((2, fp.N_LIMBS), np.int32)
+    assert "dot_general" not in str(jax.make_jaxpr(fp.mul)(a, a))
+    monkeypatch.setattr(fp, "USE_MXU_MUL", True)
+    # fresh aval shape: jax's trace cache keys on (fn, avals) and would
+    # otherwise replay the pre-flip trace
+    a3 = np.zeros((3, fp.N_LIMBS), np.int32)
+    assert "dot_general" in str(jax.make_jaxpr(fp.mul)(a3, a3))
+
+
+@pytest.mark.slow
+def test_mul_mxu_random_sweep_byte_identical():
+    """Nightly: a 64-pair random sweep through the batched MXU shape (the
+    fp.mul_mxu@B64 registry form) stays byte-identical to fp.mul."""
+    xs = [rng.randrange(P) for _ in range(64)]
+    ys = [rng.randrange(P) for _ in range(64)]
+    a = jnp.asarray(fp.to_mont_host_bulk(xs))
+    b = jnp.asarray(fp.to_mont_host_bulk(ys))
+    ref, got = (np.asarray(v) for v in _mul_mxu_drive(a, b))
+    assert np.array_equal(ref, got)
+
+
 # -- slow tier: device differentials ------------------------------------------
 
 
